@@ -1,0 +1,40 @@
+"""minpaxos_tpu — a TPU-native state-machine-replication framework.
+
+A brand-new framework with the capabilities of arobertlin/MinPaxos (a
+Go Multi-Paxos replicated key-value store; see SURVEY.md at the repo
+root), re-designed for TPU hardware: quorum voting over thousands of
+independent Paxos instances is computed as batched, data-parallel array
+ops inside single XLA-compiled steps (JAX / pjit / shard_map / Pallas),
+instead of one goroutine per message.
+
+Subpackages
+-----------
+utils      Low-level utilities (dlog, bitvec, bloomfilter, clock) —
+           array-native counterparts of reference src/dlog, src/bitvec,
+           src/bloomfilter, src/rdtsc.
+wire       Message schemas + columnar binary codec — counterpart of
+           reference src/fastrpc + src/*proto packages.
+ops        Device kernels: batched quorum math, vectorized KV state
+           machine, parallel execution engine.
+models     Consensus protocols over the quorum kernels: bareminpaxos
+           (MinPaxos), classic paxos, mencius — counterpart of reference
+           src/bareminpaxos, src/paxos, src/mencius.
+parallel   Mesh / sharding layer: shard x replica device meshes, pjit
+           partitioning of the cluster step, ICI collectives.
+runtime    Host-side replica runtime: TCP peer mesh, client listener,
+           batch-draining event loop — counterpart of src/genericsmr.
+master     Cluster coordination: registration, leader election, pings —
+           counterpart of src/master.
+storage    Durable append-only redo log + crash recovery — counterpart
+           of the reference's stable-store files.
+clients    Benchmark clients (closed-loop, retry/failover, latency,
+           open-loop, throughput-over-time) — counterpart of
+           src/client*, src/clientretry, src/clientlat, ...
+sim        Deterministic in-process multi-replica simulation + fault
+           injection (the reference's kill/revive shell-script matrix,
+           made programmatic).
+cli        server / master / client entry points (flag-compatible with
+           reference src/server, src/master, src/client).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,22 @@
+"""Optional native (C++) fast paths: cycle clock + codec stream scan.
+
+Build with ``python -m minpaxos_tpu.native.build``; everything in the
+framework works without it (pure-Python/numpy fallbacks). ``libnative``
+is None when the shared library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_LIB = os.path.join(os.path.dirname(__file__), "libminpaxos_native.so")
+
+libnative = None
+if os.path.exists(_LIB):  # pragma: no cover - depends on local build
+    try:
+        libnative = ctypes.CDLL(_LIB)
+        libnative.mp_cputicks.restype = ctypes.c_uint64
+        libnative.mp_cputicks.argtypes = []
+    except OSError:
+        libnative = None

@@ -1,0 +1,6 @@
+from minpaxos_tpu.utils.dlog import dlog, DLOG
+from minpaxos_tpu.utils.clock import cputicks, monotonic_ns
+from minpaxos_tpu.utils.bitvec import BitVec
+from minpaxos_tpu.utils.bloomfilter import BloomFilter
+
+__all__ = ["dlog", "DLOG", "cputicks", "monotonic_ns", "BitVec", "BloomFilter"]

@@ -1,0 +1,44 @@
+"""Flat bit vector over a uint64 word array.
+
+Counterpart of reference src/bitvec/bitvec.go:5-31 (`New/SetBit/GetBit/
+ResetBit/Clear`), extended with vectorized batch set/get so it can be
+used from array code (numpy) as well as scalar host code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BitVec:
+    __slots__ = ("nbits", "words")
+
+    def __init__(self, nbits: int):
+        self.nbits = int(nbits)
+        self.words = np.zeros((self.nbits + 63) // 64, dtype=np.uint64)
+
+    def set_bit(self, i: int) -> None:
+        self.words[i >> 6] |= np.uint64(1) << np.uint64(i & 63)
+
+    def reset_bit(self, i: int) -> None:
+        self.words[i >> 6] &= ~(np.uint64(1) << np.uint64(i & 63))
+
+    def get_bit(self, i: int) -> bool:
+        return bool((self.words[i >> 6] >> np.uint64(i & 63)) & np.uint64(1))
+
+    def clear(self) -> None:
+        self.words[:] = 0
+
+    # -- vectorized extensions (not in the reference) --
+
+    def set_bits(self, idx: np.ndarray) -> None:
+        """Set many bits at once (duplicates allowed)."""
+        idx = np.asarray(idx, dtype=np.int64)
+        np.bitwise_or.at(self.words, idx >> 6, np.uint64(1) << (idx & 63).astype(np.uint64))
+
+    def get_bits(self, idx: np.ndarray) -> np.ndarray:
+        idx = np.asarray(idx, dtype=np.int64)
+        return ((self.words[idx >> 6] >> (idx & 63).astype(np.uint64)) & np.uint64(1)).astype(bool)
+
+    def popcount(self) -> int:
+        return int(np.bitwise_count(self.words).sum())

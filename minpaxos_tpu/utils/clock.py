@@ -1,0 +1,38 @@
+"""High-resolution timestamps for RTT estimation and latency probes.
+
+Counterpart of reference src/rdtsc (rdtsc.s:1-8 + rdtsc_decl.go:3) — the
+reference's only native component, an x86-64 ``RDTSC`` shim used for
+beacon RTT EWMA (genericsmr.go:429, :540).
+
+Here the fast path is a tiny C shim (minpaxos_tpu/native/clock.cpp)
+exposing ``__rdtsc`` / ``CLOCK_MONOTONIC_RAW`` via ctypes; when the
+native library has not been built we fall back to
+``time.perf_counter_ns`` which is itself a thin vDSO call on Linux.
+"""
+
+from __future__ import annotations
+
+import time
+
+try:  # pragma: no cover - exercised only when the native lib is built
+    from minpaxos_tpu.native import libnative as _libnative
+except Exception:  # pragma: no cover
+    _libnative = None
+
+
+def monotonic_ns() -> int:
+    """Monotonic wall time in nanoseconds."""
+    return time.perf_counter_ns()
+
+
+if _libnative is not None and getattr(_libnative, "mp_cputicks", None) is not None:
+
+    def cputicks() -> int:
+        """Cycle counter (RDTSC on x86-64, CNTVCT on aarch64)."""
+        return _libnative.mp_cputicks()
+
+else:
+
+    def cputicks() -> int:
+        """Cycle-counter equivalent; falls back to perf_counter_ns."""
+        return time.perf_counter_ns()

@@ -1,0 +1,31 @@
+"""Debug logging, gated at import time.
+
+Counterpart of reference src/dlog/dlog.go:5-19, where a compile-time
+``const DLOG = false`` makes every call a no-op the compiler can erase.
+Python has no compile-time consts, so we read the ``MINPAXOS_DLOG`` env
+var once at import and bind ``dlog`` to a no-op when disabled — the
+per-call overhead is one dead function call, and hot paths are expected
+to guard with ``if DLOG:`` exactly like the reference's callers rely on
+the constant.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+DLOG: bool = os.environ.get("MINPAXOS_DLOG", "0") not in ("", "0", "false", "False")
+
+
+def _dlog_enabled(fmt: str, *args) -> None:
+    ts = time.monotonic()
+    msg = (fmt % args) if args else fmt
+    print(f"[dlog {ts:.6f}] {msg}", file=sys.stderr, flush=True)
+
+
+def _dlog_disabled(fmt: str, *args) -> None:  # pragma: no cover - trivial
+    pass
+
+
+dlog = _dlog_enabled if DLOG else _dlog_disabled

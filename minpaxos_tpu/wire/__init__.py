@@ -1,0 +1,14 @@
+from minpaxos_tpu.wire.messages import MsgKind, SCHEMAS, schema, empty_batch, make_batch
+from minpaxos_tpu.wire.codec import encode_frame, decode_frame, StreamDecoder, FrameWriter
+
+__all__ = [
+    "MsgKind",
+    "SCHEMAS",
+    "schema",
+    "empty_batch",
+    "make_batch",
+    "encode_frame",
+    "decode_frame",
+    "StreamDecoder",
+    "FrameWriter",
+]

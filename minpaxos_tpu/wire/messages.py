@@ -1,0 +1,192 @@
+"""Message schemas: the framework's wire vocabulary.
+
+Counterpart of the reference's fastrpc.Serializable interface
+(src/fastrpc/fastrpc.go:7-11) plus the hand-written marshaling packages
+(src/genericsmrproto, src/minpaxosproto, src/paxosproto,
+src/menciusproto — see SURVEY.md section 2.3). Three deliberate design
+departures, all TPU-motivated:
+
+1. **Columnar rows, not per-object marshal.** A message *frame* carries N
+   rows of one kind as a packed struct-of-records buffer described by a
+   numpy structured dtype. One frame therefore IS the device batch: a
+   5000-command Accept (reference MAX_BATCH, bareminpaxos.go:22) arrives
+   as 5000 rows that memcpy straight into the arrays the quorum kernel
+   consumes. No per-message object churn, no object caches
+   (gsmrprotomarsh.go:12-39 become unnecessary).
+
+2. **One row = one log slot.** The reference batches many commands into
+   ONE Paxos instance because its per-instance overhead is a goroutine
+   round. Here per-instance overhead is one array lane, so commands map
+   1:1 onto instances and "batching" is a contiguous slot range handled
+   in one XLA step. The reference's CatchUpLog (minpaxosproto.go:66-73)
+   becomes extra ACCEPT rows for older slots in the same frame.
+
+3. **Static opcode registry.** The reference assigns RPC codes in
+   registration order at runtime (genericsmr.go:492-497), an implicit
+   wire contract SURVEY.md flags as fragile. Codes here are fixed in
+   this module; both ends share them by construction.
+
+Command encoding matches reference semantics: op in {NONE, PUT, GET,
+DELETE, RLOCK, WLOCK} (state/state.go:12-19), 8-byte key, 8-byte value
+(statemarsh.go:8-21; the 1KB-value build variant state.go.1k is a
+config knob on the state machine, not the wire, see ops/kvstore.py).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class Op(enum.IntEnum):
+    """KV command opcodes — reference state/state.go:12-19."""
+
+    NONE = 0
+    PUT = 1
+    GET = 2
+    DELETE = 3
+    RLOCK = 4
+    WLOCK = 5
+
+
+class MsgKind(enum.IntEnum):
+    """Frame opcodes. Fixed forever; append-only."""
+
+    # client <-> replica (reference genericsmrproto.go:7-18)
+    PROPOSE = 1
+    PROPOSE_REPLY = 2
+    READ = 3
+    READ_REPLY = 4
+    PROPOSE_AND_READ = 5
+    PROPOSE_AND_READ_REPLY = 6
+    BEACON = 7
+    BEACON_REPLY = 8
+
+    # replica <-> replica: MinPaxos / global-ballot messages
+    # (reference minpaxosproto.go:48-94)
+    PREPARE = 16
+    PREPARE_REPLY = 17
+    ACCEPT = 18
+    ACCEPT_REPLY = 19
+    COMMIT = 20
+    COMMIT_SHORT = 21
+
+    # classic per-instance Paxos extras (reference paxosproto.go:16-55)
+    PREPARE_INST = 24
+    PREPARE_INST_REPLY = 25
+
+    # mencius extras (reference menciusproto.go:7-51)
+    SKIP = 28
+
+    # connection handshake pseudo-kinds (reference genericsmrproto.go:16-17)
+    HANDSHAKE_CLIENT = 120
+    HANDSHAKE_PEER = 121
+
+
+# Command columns shared by every frame that carries commands. 1 + 8 + 8
+# bytes — the reference's fixed 17-byte Command (statemarsh.go:8-21) —
+# plus client bookkeeping for exactly-once replies.
+_CMD_FIELDS = [
+    ("op", "u1"),
+    ("key", "<i8"),
+    ("val", "<i8"),
+    ("cmd_id", "<i4"),
+    ("client_id", "<i4"),
+]
+
+SCHEMAS: dict[MsgKind, np.dtype] = {
+    # Propose{CommandId, Command, Timestamp} — genericsmrproto.go:20-24.
+    MsgKind.PROPOSE: np.dtype(
+        [("cmd_id", "<i4"), ("op", "u1"), ("key", "<i8"), ("val", "<i8"),
+         ("timestamp", "<i8")]),
+    # ProposeReplyTS{OK, CommandId, Value, Timestamp, Leader} —
+    # genericsmrproto.go:31-37 (Leader enables client re-routing).
+    MsgKind.PROPOSE_REPLY: np.dtype(
+        [("ok", "u1"), ("cmd_id", "<i4"), ("val", "<i8"),
+         ("timestamp", "<i8"), ("leader", "i1")]),
+    # Read / ReadReply — genericsmrproto.go:39-46 (parsed-but-dropped in
+    # the reference, genericsmr.go:470-477; implemented here).
+    MsgKind.READ: np.dtype([("cmd_id", "<i4"), ("key", "<i8")]),
+    MsgKind.READ_REPLY: np.dtype([("cmd_id", "<i4"), ("val", "<i8")]),
+    MsgKind.PROPOSE_AND_READ: np.dtype(
+        [("cmd_id", "<i4"), ("op", "u1"), ("key", "<i8"), ("val", "<i8")]),
+    MsgKind.PROPOSE_AND_READ_REPLY: np.dtype(
+        [("ok", "u1"), ("cmd_id", "<i4"), ("val", "<i8")]),
+    # Beacon{Rid, Timestamp} — genericsmrproto.go:63-69.
+    MsgKind.BEACON: np.dtype([("rid", "i1"), ("timestamp", "<u8")]),
+    MsgKind.BEACON_REPLY: np.dtype([("rid", "i1"), ("timestamp", "<u8")]),
+    # Prepare{LeaderId, Ballot, LastCommitted} — minpaxosproto.go:48-54
+    # (global ballot: ONE prepare covers all instances).
+    MsgKind.PREPARE: np.dtype(
+        [("leader_id", "i1"), ("ballot", "<i4"), ("last_committed", "<i4")]),
+    # PrepareReply — minpaxosproto.go:56-64. The reference piggybacks an
+    # in-flight instance + CatchUpLog; here those travel as ACCEPT rows
+    # in the same frame batch, so the reply itself is scalar columns.
+    MsgKind.PREPARE_REPLY: np.dtype(
+        [("id", "i1"), ("ok", "u1"), ("ballot", "<i4"),
+         ("last_committed", "<i4"), ("crt_instance", "<i4")]),
+    # Accept — minpaxosproto.go:66-73. One row accepts one slot; a
+    # frame of rows is the reference's batched Accept + CatchUpLog.
+    MsgKind.ACCEPT: np.dtype(
+        [("leader_id", "i1"), ("inst", "<i4"), ("ballot", "<i4"),
+         ("last_committed", "<i4")] + _CMD_FIELDS),
+    # AcceptReply{Instance, OK, Ballot, Id} — minpaxosproto.go:75-80,
+    # extended with count so one row acks the contiguous range
+    # [inst, inst+count).
+    MsgKind.ACCEPT_REPLY: np.dtype(
+        [("id", "i1"), ("ok", "u1"), ("inst", "<i4"), ("count", "<i4"),
+         ("ballot", "<i4"), ("last_committed", "<i4")]),
+    # Commit (with command rows) / CommitShort (range only) —
+    # minpaxosproto.go:82-94.
+    MsgKind.COMMIT: np.dtype(
+        [("leader_id", "i1"), ("inst", "<i4"), ("ballot", "<i4")] + _CMD_FIELDS),
+    MsgKind.COMMIT_SHORT: np.dtype(
+        [("leader_id", "i1"), ("inst", "<i4"), ("count", "<i4"),
+         ("ballot", "<i4")]),
+    # Classic paxos per-instance Prepare{LeaderId, Instance, Ballot,
+    # ToInfinity} — paxosproto.go:16-21.
+    MsgKind.PREPARE_INST: np.dtype(
+        [("leader_id", "i1"), ("inst", "<i4"), ("ballot", "<i4"),
+         ("to_infinity", "u1")]),
+    MsgKind.PREPARE_INST_REPLY: np.dtype(
+        [("id", "i1"), ("ok", "u1"), ("inst", "<i4"), ("ballot", "<i4"),
+         ("vballot", "<i4")] + _CMD_FIELDS),
+    # Mencius Skip{LeaderId, StartInstance, EndInstance} —
+    # menciusproto.go:7-11.
+    MsgKind.SKIP: np.dtype(
+        [("leader_id", "i1"), ("start_inst", "<i4"), ("end_inst", "<i4")]),
+}
+
+
+def schema(kind: MsgKind) -> np.dtype:
+    try:
+        return SCHEMAS[MsgKind(kind)]
+    except KeyError:
+        # e.g. HANDSHAKE_* pseudo-kinds: raw single bytes exchanged
+        # before framed streaming starts, never valid as frames.
+        raise ValueError(f"no frame schema for kind {kind}") from None
+
+
+def empty_batch(kind: MsgKind, n: int = 0) -> np.ndarray:
+    """A zeroed structured array of n rows of the given kind."""
+    return np.zeros(n, dtype=schema(kind))
+
+
+def make_batch(kind: MsgKind, **cols) -> np.ndarray:
+    """Build a structured batch from column arrays (broadcast scalars).
+
+    >>> make_batch(MsgKind.ACCEPT, inst=np.arange(4), ballot=3, op=1,
+    ...            key=np.arange(4), val=0, cmd_id=0, client_id=0,
+    ...            leader_id=0, last_committed=-1)
+    """
+    dt = schema(kind)
+    n = 1
+    for v in cols.values():
+        a = np.asarray(v)
+        if a.ndim > 0:
+            n = max(n, a.shape[0])
+    out = np.zeros(n, dtype=dt)
+    for name, v in cols.items():
+        out[name] = v
+    return out

@@ -1,0 +1,108 @@
+"""Codec round-trip tests (reference *marsh.go equivalents)."""
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.wire import (
+    MsgKind,
+    StreamDecoder,
+    decode_frame,
+    empty_batch,
+    encode_frame,
+    make_batch,
+)
+from minpaxos_tpu.wire.messages import SCHEMAS, Op
+
+
+@pytest.mark.parametrize("kind", list(SCHEMAS))
+def test_roundtrip_random(kind):
+    rng = np.random.default_rng(int(kind))
+    rows = empty_batch(kind, 17)
+    for name, (dt, _) in rows.dtype.fields.items():
+        info = np.iinfo(dt)
+        rows[name] = rng.integers(info.min, info.max, size=17, dtype=dt)
+    wire = encode_frame(kind, rows)
+    k2, rows2, used = decode_frame(wire)
+    assert k2 == kind and used == len(wire)
+    assert (rows2 == rows).all()
+
+
+def test_roundtrip_empty():
+    wire = encode_frame(MsgKind.COMMIT_SHORT, empty_batch(MsgKind.COMMIT_SHORT, 0))
+    k, rows, used = decode_frame(wire)
+    assert k == MsgKind.COMMIT_SHORT and len(rows) == 0 and used == len(wire)
+
+
+def test_make_batch_broadcast():
+    b = make_batch(
+        MsgKind.ACCEPT,
+        inst=np.arange(8, dtype=np.int32),
+        ballot=3,
+        op=Op.PUT,
+        key=np.arange(8),
+        val=7,
+        cmd_id=0,
+        client_id=1,
+        leader_id=0,
+        last_committed=-1,
+    )
+    assert len(b) == 8
+    assert (b["ballot"] == 3).all()
+    assert (b["inst"] == np.arange(8)).all()
+
+
+def test_stream_decoder_fragmentation():
+    frames = [
+        (MsgKind.PREPARE, make_batch(MsgKind.PREPARE, leader_id=0, ballot=16, last_committed=-1)),
+        (MsgKind.ACCEPT, make_batch(
+            MsgKind.ACCEPT, inst=np.arange(100, dtype=np.int32), ballot=16,
+            op=Op.PUT, key=np.arange(100), val=np.arange(100) * 2,
+            cmd_id=np.arange(100), client_id=5, leader_id=0, last_committed=-1)),
+        (MsgKind.ACCEPT_REPLY, make_batch(
+            MsgKind.ACCEPT_REPLY, id=1, ok=1, inst=0, count=100, ballot=16,
+            last_committed=-1)),
+    ]
+    wire = b"".join(encode_frame(k, r) for k, r in frames)
+    # feed in awkward chunk sizes
+    dec = StreamDecoder()
+    got = []
+    for i in range(0, len(wire), 7):
+        got.extend(dec.feed(wire[i : i + 7]))
+    assert dec.pending_bytes() == 0
+    assert len(got) == len(frames)
+    for (k1, r1), (k2, r2) in zip(frames, got):
+        assert k1 == k2 and (r1 == r2).all()
+
+
+def test_decoder_rejects_bad_opcode():
+    with pytest.raises(ValueError):
+        decode_frame(bytes([255, 1, 0, 0, 0]) + b"x" * 64)
+
+
+def test_handshake_kinds_have_no_schema_but_latch_cleanly():
+    import struct
+
+    good = encode_frame(MsgKind.READ, make_batch(MsgKind.READ, cmd_id=1, key=2))
+    dec = StreamDecoder()
+    out = dec.feed(good + struct.pack("<BI", int(MsgKind.HANDSHAKE_CLIENT), 0) + good)
+    assert len(out) == 1 and dec.error is not None
+
+
+def test_encode_frame_rejects_oversized_batch():
+    from minpaxos_tpu.wire.codec import MAX_FRAME_ROWS
+
+    rows = np.zeros(MAX_FRAME_ROWS + 1, dtype=np.dtype([("cmd_id", "<i4"), ("key", "<i8")]))
+    with pytest.raises(ValueError):
+        encode_frame(MsgKind.READ, rows)
+
+
+def test_stream_decoder_corruption_latches():
+    good = encode_frame(MsgKind.READ, make_batch(MsgKind.READ, cmd_id=1, key=2))
+    dec = StreamDecoder()
+    out = dec.feed(good + bytes([200, 1, 0, 0, 0]) + good)
+    # frames before the corruption are preserved, error is latched
+    assert len(out) == 1 and out[0][0] == MsgKind.READ
+    assert dec.error is not None
+    with pytest.raises(ValueError):
+        dec.feed(b"")
+
