@@ -1,0 +1,16 @@
+"""Consensus protocols as jitted array state machines.
+
+Each protocol module defines a per-replica pytree state and a pure
+``step(state, inbox) -> (state, outbox, effects)`` function; the host
+runtime (minpaxos_tpu.runtime) and the pod-mode cluster
+(minpaxos_tpu.models.cluster) both drive the same step functions.
+"""
+
+from minpaxos_tpu.models.minpaxos import (
+    MinPaxosConfig,
+    ReplicaState,
+    init_replica,
+    replica_step,
+)
+
+__all__ = ["MinPaxosConfig", "ReplicaState", "init_replica", "replica_step"]

@@ -1,0 +1,278 @@
+"""Pod-mode cluster: every replica resident on the accelerator, one
+jitted step advancing them all.
+
+This is the TPU-native reframing SURVEY.md section 7.1 calls for: where
+the reference runs N processes exchanging TCP messages
+(genericsmr.go:125-172), pod mode stacks the N replicas' states along a
+leading array axis, runs the identical per-replica protocol step under
+``vmap``, and *routes messages as array ops*: each replica's outbox rows
+carry a ``dst``; routing pools all outboxes and compacts each replica's
+addressed rows into its next inbox with a cumsum-scatter (stable, no
+sort). Replica failure is a mask (see ``alive``): a dead replica's rows
+are dropped and its inbox zeroed — the programmatic version of the
+reference's kill/revive scripts.
+
+The same ``replica_step_impl`` drives both this mode and the
+distributed TCP runtime, so protocol correctness proven here (against
+the oracle in tests/test_minpaxos_protocol.py) transfers to the wire.
+
+Sharding: models/cluster.py is mesh-agnostic; parallel/sharded.py lays
+the shard axis of a sharded-Paxos deployment over devices with the
+replica axis inside each shard.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from minpaxos_tpu.models.minpaxos import (
+    ExecResult,
+    MinPaxosConfig,
+    MsgBatch,
+    ReplicaState,
+    _concat_rows,
+    become_leader,
+    init_replica,
+    replica_step_impl,
+)
+from minpaxos_tpu.ops.packed import join_i64, split_i64
+from minpaxos_tpu.wire.messages import MsgKind, Op
+
+
+class ClusterState(NamedTuple):
+    states: ReplicaState  # stacked, leading axis R
+    pending: MsgBatch  # [R, M] routed but undelivered messages
+    alive: jnp.ndarray  # bool[R] failure-injection mask
+
+
+def _tree_stack(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_slice(tree, i):
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
+def tree_set(tree, i, sub):
+    return jax.tree_util.tree_map(lambda x, s: x.at[i].set(s), tree, sub)
+
+
+def _route(cfg: MinPaxosConfig, out_msgs: MsgBatch, dst: jnp.ndarray,
+           alive: jnp.ndarray, capacity: int) -> MsgBatch:
+    """Pool all replicas' outboxes and build each replica's next inbox.
+
+    dst semantics: -1 broadcast to all *other* replicas, >=0 unicast,
+    -2 client-bound (excluded here; the host collects those).
+    Overflow beyond ``capacity`` rows is dropped — legal under Paxos
+    (message loss), sized to be impossible in steady state.
+    """
+    r = cfg.n_replicas
+    flat = jax.tree_util.tree_map(lambda x: x.reshape(-1), out_msgs)  # [R*M]
+    src_rep = jnp.repeat(jnp.arange(r), out_msgs.kind.shape[1])
+    fdst = dst.reshape(-1)
+    live_src = alive[src_rep]
+
+    def inbox_for(me):
+        mine = (flat.kind != 0) & live_src & alive[me] & (src_rep != me) & (
+            (fdst == -1) | (fdst == me))
+        pos = jnp.cumsum(mine.astype(jnp.int32)) - 1
+        tgt = jnp.where(mine & (pos < capacity), pos, capacity)
+        return jax.tree_util.tree_map(
+            lambda col: jnp.zeros(capacity, col.dtype).at[tgt].set(
+                col, mode="drop"),
+            flat)
+
+    return jax.vmap(inbox_for)(jnp.arange(r))
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def cluster_step(
+    cfg: MinPaxosConfig, cs: ClusterState, ext: MsgBatch
+) -> tuple[ClusterState, "ExecResult", MsgBatch, jnp.ndarray]:
+    """One synchronous round: deliver pending + ext, step all replicas,
+    route the new outboxes.
+
+    ext is [R, Mext] host-injected rows (client proposes to the leader,
+    PREPAREs from elections). Returns (state', exec results [R, E],
+    client-bound rows [R, M_total], client-bound mask).
+    """
+    inbox = _concat_rows(cs.pending, ext)
+    # dead replicas see silence
+    inbox = inbox._replace(
+        kind=jnp.where(cs.alive[:, None], inbox.kind, 0))
+    states, outbox, execr = jax.vmap(
+        functools.partial(replica_step_impl, cfg))(cs.states, inbox)
+    pending = _route(cfg, outbox.msgs, outbox.dst, cs.alive, cfg.inbox)
+    client_rows = outbox.msgs
+    client_mask = (outbox.dst == -2) & (outbox.msgs.kind != 0)
+    return ClusterState(states, pending, cs.alive), execr, client_rows, client_mask
+
+
+class Cluster:
+    """Host-side convenience wrapper: boot, propose, crash, recover.
+
+    The programmatic equivalent of the reference's shell harness
+    (bareminrun.sh boots master + 3 replicas; kill/revive scripts
+    inject faults — SURVEY.md section 4).
+    """
+
+    def __init__(self, cfg: MinPaxosConfig, ext_rows: int = 1024):
+        self.cfg = cfg
+        self.ext_rows = ext_rows
+        states = _tree_stack([init_replica(cfg, i) for i in range(cfg.n_replicas)])
+        self.cs = ClusterState(
+            states=states,
+            pending=jax.tree_util.tree_map(
+                lambda x: jnp.zeros((cfg.n_replicas,) + x.shape, x.dtype),
+                MsgBatch.empty(cfg.inbox)),
+            alive=jnp.ones(cfg.n_replicas, dtype=bool),
+        )
+        self._ext_queue: list[tuple[int, np.ndarray]] = []  # (replica, rows)
+        self.replies: dict[tuple[int, int], dict] = {}  # (client_id, cmd_id) -> reply
+        self.reply_log: list[dict] = []
+        # replies are connection-scoped: only the replica a client
+        # proposed to replies (reference lb.clientProposals,
+        # bareminpaxos.go:75-82); other replicas execute silently
+        self._proposed_at: dict[tuple[int, int], int] = {}
+
+    # -- control plane --
+
+    @property
+    def leader(self) -> int:
+        """Leader per the highest-ballot alive replica (what a client
+        would learn from GetLeader + ProposeReplyTS.Leader hints)."""
+        alive = np.asarray(self.cs.alive)
+        ballots = np.asarray(self.cs.states.default_ballot)
+        leaders = np.asarray(self.cs.states.leader_id)
+        cand = np.where(alive, ballots, -(2**31))
+        return int(leaders[int(np.argmax(cand))])
+
+    def elect(self, replica: int) -> None:
+        """BeTheLeader: run a real Prepare round via ext PREPARE rows."""
+        st = tree_slice(self.cs.states, replica)
+        st, prep = become_leader(self.cfg, st)
+        states = tree_set(self.cs.states, replica, st)
+        self.cs = self.cs._replace(states=states)
+        row = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], prep)
+        for peer in range(self.cfg.n_replicas):
+            if peer != replica:
+                self._ext_queue.append((peer, row))
+
+    def kill(self, replica: int) -> None:
+        self.cs = self.cs._replace(alive=self.cs.alive.at[replica].set(False))
+
+    def revive(self, replica: int) -> None:
+        self.cs = self.cs._replace(alive=self.cs.alive.at[replica].set(True))
+
+    # -- data plane --
+
+    def propose(self, ops, keys, vals, cmd_ids, client_id: int, to: int | None = None):
+        """Queue client PROPOSE rows for delivery to ``to`` (default:
+        current leader) on the next step. Batches larger than
+        ``ext_rows`` are chunked across steps."""
+        to = self.leader if to is None else to
+        if to < 0:
+            raise ValueError("no known leader; call elect() first or pass to=")
+        ops = np.asarray(ops, dtype=np.int32)
+        k_hi, k_lo = split_i64(np.asarray(keys))
+        v_hi, v_lo = split_i64(np.asarray(vals))
+        n = len(ops)
+        row = dict(
+            kind=np.full(n, int(MsgKind.PROPOSE), np.int32),
+            src=np.full(n, -1, np.int32),
+            ballot=np.zeros(n, np.int32),
+            inst=np.zeros(n, np.int32),
+            last_committed=np.zeros(n, np.int32),
+            op=ops,
+            key_hi=k_hi.astype(np.int32),
+            key_lo=k_lo.astype(np.int32),
+            val_hi=v_hi.astype(np.int32),
+            val_lo=v_lo.astype(np.int32),
+            cmd_id=np.asarray(cmd_ids, dtype=np.int32),
+            client_id=np.full(n, client_id, np.int32),
+        )
+        for mid in np.asarray(cmd_ids, dtype=np.int64):
+            self._proposed_at[(client_id, int(mid))] = to
+        batch = MsgBatch(**{f: row[f] for f in MsgBatch._fields})
+        for lo in range(0, n, self.ext_rows):
+            self._ext_queue.append((to, jax.tree_util.tree_map(
+                lambda x: x[lo : lo + self.ext_rows], batch)))
+
+    def _drain_ext(self) -> MsgBatch:
+        r, m = self.cfg.n_replicas, self.ext_rows
+        cols = {f: np.zeros((r, m), np.int32) for f in MsgBatch._fields}
+        fill = [0] * r
+        rest = []
+        for to, rows in self._ext_queue:
+            arrs = rows._asdict() if isinstance(rows, MsgBatch) else rows
+            n = np.atleast_1d(arrs["kind"]).shape[0]
+            if fill[to] + n > m:
+                rest.append((to, rows))
+                continue
+            sl = slice(fill[to], fill[to] + n)
+            for f in MsgBatch._fields:
+                cols[f][to, sl] = arrs[f]
+            fill[to] += n
+        self._ext_queue = rest
+        return MsgBatch(**{f: jnp.asarray(cols[f]) for f in MsgBatch._fields})
+
+    def step(self) -> None:
+        """One cluster round + host-side reply collection."""
+        ext = self._drain_ext()
+        self.cs, execr, crows, cmask = cluster_step(self.cfg, self.cs, ext)
+        self._collect_exec(execr)
+        self._collect_client_rows(crows, cmask)
+
+    def run(self, n: int) -> None:
+        for _ in range(n):
+            self.step()
+
+    # -- reply collection (host side of ReplyProposeTS, genericsmr.go:529) --
+
+    def _collect_exec(self, execr: ExecResult) -> None:
+        counts = np.asarray(execr.count)
+        # one transfer per field, then pure-numpy indexing
+        e_vhi, e_vlo = np.asarray(execr.val_hi), np.asarray(execr.val_lo)
+        e_found, e_op = np.asarray(execr.found), np.asarray(execr.op)
+        e_cid, e_mid = np.asarray(execr.client_id), np.asarray(execr.cmd_id)
+        e_lo = np.asarray(execr.lo)
+        for rep in range(self.cfg.n_replicas):
+            if not counts[rep]:
+                continue
+            n = int(counts[rep])
+            vals = join_i64(e_vhi[rep][:n], e_vlo[rep][:n])
+            for i in range(n):
+                cid = int(e_cid[rep][i])
+                mid = int(e_mid[rep][i])
+                if cid < 0:  # no-op fill, nobody to reply to
+                    continue
+                if self._proposed_at.get((cid, mid)) != rep:
+                    continue  # executed here, but the client's conn is elsewhere
+                rep_row = dict(ok=True, value=int(vals[i]),
+                               found=bool(e_found[rep][i]),
+                               op=int(e_op[rep][i]),
+                               inst=int(e_lo[rep]) + i)
+                if (cid, mid) in self.replies:
+                    self.reply_log.append(dict(duplicate=True, client_id=cid,
+                                               cmd_id=mid))
+                self.replies[(cid, mid)] = rep_row
+                self.reply_log.append(dict(duplicate=False, client_id=cid,
+                                           cmd_id=mid, **rep_row))
+
+    def _collect_client_rows(self, crows: MsgBatch, cmask) -> None:
+        cmask = np.asarray(cmask)
+        if not cmask.any():
+            return
+        kinds = np.asarray(crows.kind)
+        for rep, i in zip(*np.nonzero(cmask)):
+            if kinds[rep, i] == int(MsgKind.PROPOSE_REPLY):
+                cid = int(np.asarray(crows.client_id[rep, i]))
+                mid = int(np.asarray(crows.cmd_id[rep, i]))
+                self.reply_log.append(dict(
+                    duplicate=False, client_id=cid, cmd_id=mid, ok=False,
+                    leader=int(np.asarray(crows.ballot[rep, i]))))

@@ -1,0 +1,698 @@
+"""MinPaxos (global-ballot stable-leader Multi-Paxos) as a batched
+array state machine.
+
+Counterpart of reference src/bareminpaxos/bareminpaxos.go — the thesis
+protocol: ONE global ballot covers every instance (one Prepare round
+elects a leader for the whole log, bareminpaxos.go:394-446), Accepts
+piggyback the leader's commit frontier (``LastCommitted``) so there is
+no Commit broadcast on the hot path (SURVEY.md section 3.2), and a
+follower that falls behind is healed with explicit catch-up rows.
+
+The reference advances one instance per goroutine event
+(bareminpaxos.go:292-381). Here one jitted ``replica_step`` consumes a
+fixed-capacity batch of messages (any mix of kinds) and advances the
+whole log window with branch-free masked array ops:
+
+* propose handling = prefix-sum slot assignment + scatter
+  (vs handlePropose bareminpaxos.go:617-710);
+* accept handling = masked ballot-compare + scatter + per-row acks
+  (vs handleAccept :753-806);
+* vote counting = boolean scatter into a [S, R] vote table
+  (vs handleAcceptReply :1014-1064);
+* commit frontier = one cumulative scan (vs updateCommittedUpTo
+  :387-392);
+* execution = the parallel KV engine applying a committed range
+  (vs executeCommands :1066-1098).
+
+Message routing, durability, and ragged catch-up stay on the host
+(runtime/) or in the pod-mode cluster composition (models/cluster.py):
+the reference's cold paths deliberately stay off the device
+(SURVEY.md section 7.4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
+from minpaxos_tpu.ops.scan import commit_frontier
+from minpaxos_tpu.wire.messages import MsgKind
+
+# Log-slot statuses (reference minpaxosproto.go:8-15 plus EXECUTED,
+# which the reference tracks implicitly via the exec cursor).
+NONE, PREPARING, PREPARED, ACCEPTED, COMMITTED, EXECUTED = range(6)
+
+NO_BALLOT = -1
+
+
+def make_ballot(counter, replica_id):
+    """(counter << 4) | id — reference bareminpaxos.go:383-385; caps
+    replicas at 16, like the reference."""
+    return counter * 16 + replica_id
+
+
+class MinPaxosConfig(NamedTuple):
+    """Static (compile-time) protocol parameters."""
+
+    n_replicas: int = 3
+    window: int = 1 << 16  # log slots resident on device (ref: 15M preallocated)
+    inbox: int = 4096  # message rows per step
+    exec_batch: int = 4096  # max slots executed per step
+    kv_pow2: int = 16  # KV table capacity 2**kv_pow2
+    catchup_rows: int = 64  # catch-up ACCEPT rows per step (CatchUpLog batch)
+    recovery_rows: int = 256  # uncommitted-suffix rows shipped per PREPARE
+    noop_delay: int = 8  # stalled steps before a gap slot is no-op filled
+
+    @property
+    def majority(self) -> int:
+        return self.n_replicas // 2 + 1
+
+
+class MsgBatch(NamedTuple):
+    """Fixed-capacity struct-of-arrays message batch (device side).
+
+    kind==0 rows are padding. One row touches one log slot; wire frames
+    map rows 1:1 (wire/messages.py design note #2).
+    """
+
+    kind: jnp.ndarray  # i32[M]
+    src: jnp.ndarray  # i32[M] sender replica (-1 for clients)
+    ballot: jnp.ndarray  # i32[M]
+    inst: jnp.ndarray  # i32[M] absolute instance number
+    last_committed: jnp.ndarray  # i32[M]
+    op: jnp.ndarray  # i32[M]
+    key_hi: jnp.ndarray
+    key_lo: jnp.ndarray
+    val_hi: jnp.ndarray
+    val_lo: jnp.ndarray
+    cmd_id: jnp.ndarray
+    client_id: jnp.ndarray
+
+    @staticmethod
+    def empty(m: int) -> "MsgBatch":
+        z = jnp.zeros(m, dtype=jnp.int32)
+        return MsgBatch(*([z] * 12))
+
+
+class Outbox(NamedTuple):
+    """Per-input-row responses: out row i is derived from inbox row i.
+
+    dst == -1 means broadcast to all peers; otherwise a replica id.
+    PROPOSE_REPLY rows are addressed to clients (host resolves the
+    connection from client_id).
+    """
+
+    msgs: MsgBatch
+    dst: jnp.ndarray  # i32[M]
+
+
+class ExecResult(NamedTuple):
+    """Newly executed slots this step (for -dreply replies and reads)."""
+
+    lo: jnp.ndarray  # i32: first executed absolute slot
+    count: jnp.ndarray  # i32
+    val_hi: jnp.ndarray  # i32[E]
+    val_lo: jnp.ndarray  # i32[E]
+    found: jnp.ndarray  # bool[E]
+    op: jnp.ndarray  # i32[E] command op per executed slot
+    cmd_id: jnp.ndarray  # i32[E]
+    client_id: jnp.ndarray  # i32[E]
+
+
+class ReplicaState(NamedTuple):
+    """Everything one replica owns, as device arrays."""
+
+    # log window [S]
+    ballot: jnp.ndarray  # i32: accepted ballot per slot
+    status: jnp.ndarray  # i32
+    op: jnp.ndarray
+    key_hi: jnp.ndarray
+    key_lo: jnp.ndarray
+    val_hi: jnp.ndarray
+    val_lo: jnp.ndarray
+    cmd_id: jnp.ndarray
+    client_id: jnp.ndarray
+    votes: jnp.ndarray  # bool[S, R]
+    # scalars
+    me: jnp.ndarray  # i32
+    window_base: jnp.ndarray  # i32 absolute slot of window index 0
+    crt_inst: jnp.ndarray  # i32 next unassigned absolute slot
+    committed_upto: jnp.ndarray  # i32 absolute, -1 before any commit
+    executed_upto: jnp.ndarray  # i32
+    default_ballot: jnp.ndarray  # i32 promised/current global ballot
+    max_recv_ballot: jnp.ndarray  # i32
+    leader_id: jnp.ndarray  # i32 (-1 unknown)
+    prepared: jnp.ndarray  # bool: leader has prepare majority
+    prepare_oks: jnp.ndarray  # bool[R]
+    # leader's knowledge of each peer's commit frontier, fed by the
+    # last_committed piggyback on replies (reference peerCommits,
+    # bareminpaxos.go:80, :1050) — drives catch-up targeting
+    peer_commits: jnp.ndarray  # i32[R]
+    tick: jnp.ndarray  # i32 step counter (round-robin catch-up target)
+    stall_ticks: jnp.ndarray  # i32 consecutive steps the frontier stalled
+    kv: KVState
+
+    @property
+    def is_leader(self):
+        return self.leader_id == self.me
+
+
+def init_replica(cfg: MinPaxosConfig, me: int) -> ReplicaState:
+    s, r = cfg.window, cfg.n_replicas
+    zi = jnp.zeros(s, dtype=jnp.int32)
+    return ReplicaState(
+        ballot=jnp.full(s, NO_BALLOT, dtype=jnp.int32),
+        status=zi,
+        op=zi,
+        key_hi=zi,
+        key_lo=zi,
+        val_hi=zi,
+        val_lo=zi,
+        cmd_id=zi,
+        client_id=zi,
+        votes=jnp.zeros((s, r), dtype=bool),
+        me=jnp.int32(me),
+        window_base=jnp.int32(0),
+        crt_inst=jnp.int32(0),
+        committed_upto=jnp.int32(-1),
+        executed_upto=jnp.int32(-1),
+        default_ballot=jnp.int32(NO_BALLOT),
+        max_recv_ballot=jnp.int32(NO_BALLOT),
+        leader_id=jnp.int32(-1),
+        prepared=jnp.asarray(False),
+        prepare_oks=jnp.zeros(r, dtype=bool),
+        peer_commits=jnp.full(r, -1, dtype=jnp.int32),
+        tick=jnp.int32(0),
+        stall_ticks=jnp.int32(0),
+        kv=kv_init(cfg.kv_pow2),
+    )
+
+
+def become_leader(cfg: MinPaxosConfig, state: ReplicaState) -> tuple[ReplicaState, MsgBatch]:
+    """Start an election: bump to a fresh unique ballot and emit a
+    broadcast PREPARE row.
+
+    Counterpart of bcastPrepare (bareminpaxos.go:394-446) triggered by
+    initial boot (:286-290) or the master's BeTheLeader RPC (:220-223).
+    Unlike the reference's BeTheLeader (which flips the flag without
+    re-preparing — SURVEY.md section 3.4 note), this always runs a real
+    Prepare round; `prepared` gates proposals until majority.
+    """
+    counter = state.max_recv_ballot // 16 + 1
+    new_ballot = make_ballot(counter, state.me)
+    state = state._replace(
+        default_ballot=new_ballot,
+        max_recv_ballot=jnp.maximum(state.max_recv_ballot, new_ballot),
+        leader_id=state.me,
+        prepared=jnp.asarray(False),
+        prepare_oks=jnp.zeros(cfg.n_replicas, dtype=bool).at[state.me].set(True),
+    )
+    out = MsgBatch.empty(1)
+    out = out._replace(
+        kind=jnp.full(1, int(MsgKind.PREPARE), jnp.int32),
+        src=jnp.full(1, state.me, jnp.int32),
+        ballot=jnp.full(1, new_ballot, jnp.int32),
+        last_committed=jnp.full(1, state.committed_upto, jnp.int32),
+    )
+    return state, out
+
+
+def _concat_rows(a: MsgBatch, b: MsgBatch) -> MsgBatch:
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.concatenate([x, y], axis=-1), a, b)
+
+
+def _rel(state: ReplicaState, inst, window: int):
+    """Absolute instance -> window index; out-of-window -> `window`
+    (a drop sentinel for scatter mode='drop')."""
+    rel = inst - state.window_base
+    ok = (rel >= 0) & (rel < window)
+    return jnp.where(ok, rel, window), ok
+
+
+def replica_step_impl(
+    cfg: MinPaxosConfig, state: ReplicaState, inbox: MsgBatch
+) -> tuple[ReplicaState, Outbox, ExecResult]:
+    """Advance one replica by one batch of messages (pure, unjitted —
+    models/cluster.py vmaps this over the replica axis).
+
+    Handles every message kind in one fused, branch-free pass; see
+    module docstring for the reference-call mapping.
+    """
+    S, R = cfg.window, cfg.n_replicas
+    M = inbox.kind.shape[0]  # actual batch rows (pending + ext concat)
+    majority = cfg.majority
+    k = inbox.kind
+    is_prep = k == int(MsgKind.PREPARE)
+    is_prep_reply = k == int(MsgKind.PREPARE_REPLY)
+    is_accept = k == int(MsgKind.ACCEPT)
+    is_accept_reply = k == int(MsgKind.ACCEPT_REPLY)
+    is_commit = k == int(MsgKind.COMMIT)
+    is_cshort = k == int(MsgKind.COMMIT_SHORT)
+    is_propose = k == int(MsgKind.PROPOSE)
+
+    out = MsgBatch.empty(M)
+    dst = jnp.full(M, -1, jnp.int32)
+
+    # ---- 1. PREPARE (handlePrepare bareminpaxos.go:712-751) ----
+    # Adopt the highest proposed ballot if it beats our promise.
+    prep_ballot = jnp.max(jnp.where(is_prep, inbox.ballot, NO_BALLOT))
+    any_prep = is_prep.any()
+    prep_src = inbox.src[jnp.argmax(jnp.where(is_prep, inbox.ballot, NO_BALLOT))]
+    adopt = any_prep & (prep_ballot > state.default_ballot)
+    new_default = jnp.where(adopt, prep_ballot, state.default_ballot)
+    new_leader = jnp.where(adopt, prep_src, state.leader_id)
+    prepared = jnp.where(adopt, False, state.prepared)
+    state = state._replace(
+        default_ballot=new_default,
+        leader_id=new_leader,
+        prepared=prepared,
+        max_recv_ballot=jnp.maximum(state.max_recv_ballot, prep_ballot),
+    )
+    # reply per PREPARE row (ok iff its ballot is the adopted one)
+    prep_ok = is_prep & (inbox.ballot >= state.default_ballot)
+    out = out._replace(
+        kind=jnp.where(is_prep, int(MsgKind.PREPARE_REPLY), out.kind),
+        src=jnp.where(is_prep, state.me, out.src),
+        ballot=jnp.where(is_prep, state.default_ballot, out.ballot),
+        # inst carries our highest known instance (for leader catch-up)
+        inst=jnp.where(is_prep, state.crt_inst, out.inst),
+        last_committed=jnp.where(is_prep, state.committed_upto, out.last_committed),
+        op=jnp.where(is_prep, prep_ok.astype(jnp.int32), out.op),  # op = ok flag
+    )
+    dst = jnp.where(is_prep, inbox.src, dst)
+
+    # ---- 1b. recovery suffix (PrepareReply.CatchUpLog + in-flight
+    # instance, minpaxosproto.go:56-64) ----
+    # On adopting a new leader's ballot, ship our ACCEPTED/COMMITTED
+    # slots beyond the leader's committed frontier as
+    # PREPARE_INST_REPLY rows (ballot = the slot's vballot,
+    # last_committed = the adopted prepare ballot as a context tag).
+    # Bounded at cfg.recovery_rows: like the reference, recovery
+    # assumes the in-flight window fits one reply (the runtime layers
+    # deliver outboxes reliably; see module docstring).
+    K2 = cfg.recovery_rows
+    prep_lc = inbox.last_committed[
+        jnp.argmax(jnp.where(is_prep, inbox.ballot, NO_BALLOT))]
+    rec_slots = prep_lc + 1 + jnp.arange(K2, dtype=jnp.int32)
+    rec_rel = rec_slots - state.window_base
+    rec_rel_safe = jnp.clip(rec_rel, 0, S - 1)
+    rec_ok = (
+        adopt
+        & (rec_slots < state.crt_inst)
+        & (rec_rel >= 0) & (rec_rel < S)
+        & (state.status[rec_rel_safe] >= ACCEPTED)
+    )
+    rec = MsgBatch(
+        kind=jnp.where(rec_ok, int(MsgKind.PREPARE_INST_REPLY), 0).astype(jnp.int32),
+        src=jnp.full(K2, state.me, jnp.int32),
+        ballot=state.ballot[rec_rel_safe],
+        inst=rec_slots,
+        last_committed=jnp.full(K2, state.default_ballot, jnp.int32),
+        op=state.op[rec_rel_safe],
+        key_hi=state.key_hi[rec_rel_safe],
+        key_lo=state.key_lo[rec_rel_safe],
+        val_hi=state.val_hi[rec_rel_safe],
+        val_lo=state.val_lo[rec_rel_safe],
+        cmd_id=state.cmd_id[rec_rel_safe],
+        client_id=state.client_id[rec_rel_safe],
+    )
+
+    # ---- 1c. PREPARE_INST_REPLY adoption (new leader learns peers'
+    # uncommitted values — handlePrepareReply's log-suffix merge,
+    # bareminpaxos.go:934-947) ----
+    is_pir = k == int(MsgKind.PREPARE_INST_REPLY)
+    rel_v, in_win_v = _rel(state, inbox.inst, S)
+    rel_v_safe = jnp.minimum(rel_v, S - 1)
+    pir_ok = (
+        is_pir
+        & state.is_leader
+        & (inbox.last_committed == state.default_ballot)
+        & in_win_v
+        & (state.status[rel_v_safe] < COMMITTED)
+        & (inbox.ballot > state.ballot[rel_v_safe])
+    )
+    # max-vballot wins per slot within the batch
+    vb_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
+        jnp.where(pir_ok, rel_v, S)].max(inbox.ballot, mode="drop")
+    pir_win = pir_ok & (inbox.ballot == vb_max[rel_v_safe])
+    tgt_v = jnp.where(pir_win, rel_v, S)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_v].set(inbox.ballot, mode="drop"),
+        status=state.status.at[tgt_v].set(ACCEPTED, mode="drop"),
+        op=state.op.at[tgt_v].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt_v].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt_v].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt_v].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt_v].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt_v].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt_v].set(inbox.client_id, mode="drop"),
+        votes=state.votes.at[tgt_v].set(
+            jnp.broadcast_to(jax.nn.one_hot(state.me, R, dtype=bool), (M, R)),
+            mode="drop"),
+        crt_inst=jnp.maximum(
+            state.crt_inst, jnp.max(jnp.where(pir_ok, inbox.inst, -1)) + 1),
+    )
+
+    # ---- 2. ACCEPT (handleAccept :753-806) ----
+    # Seeing a higher ballot in an ACCEPT also deposes us: a leader
+    # that missed the new leader's PREPARE must stop serving, or two
+    # leaders could emit conflicting ACCEPTs at the same ballot.
+    acc_max_ballot = jnp.max(jnp.where(is_accept, inbox.ballot, NO_BALLOT))
+    deposed = acc_max_ballot > state.default_ballot
+    acc_max_src = inbox.src[
+        jnp.argmax(jnp.where(is_accept, inbox.ballot, NO_BALLOT))]
+    state = state._replace(
+        leader_id=jnp.where(deposed, acc_max_src, state.leader_id),
+        prepared=jnp.where(deposed, False, state.prepared),
+    )
+    rel_a, in_win = _rel(state, inbox.inst, S)
+    rel_a_safe = jnp.minimum(rel_a, S - 1)
+    acc_pre = (
+        is_accept
+        & in_win
+        & (inbox.ballot >= state.default_ballot)
+        & (inbox.ballot >= state.ballot[rel_a_safe])
+        & (state.status[rel_a_safe] < COMMITTED)
+    )
+    # duplicate rows for one slot (old + new leader in one pooled
+    # inbox): only the max-ballot row may write, or per-field scatter
+    # could tear the slot (ballot from one row, value from another)
+    ab_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
+        jnp.where(acc_pre, rel_a, S)].max(inbox.ballot, mode="drop")
+    acc_ok = acc_pre & (inbox.ballot == ab_max[rel_a_safe])
+    tgt = jnp.where(acc_ok, rel_a, S)  # S drops
+    state = state._replace(
+        ballot=state.ballot.at[tgt].set(inbox.ballot, mode="drop"),
+        status=state.status.at[tgt].set(ACCEPTED, mode="drop"),
+        op=state.op.at[tgt].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt].set(inbox.client_id, mode="drop"),
+        # accepting a newer ballot supersedes any older votes
+        votes=state.votes.at[tgt].set(
+            jax.nn.one_hot(inbox.src, R, dtype=bool), mode="drop"),
+        default_ballot=jnp.maximum(state.default_ballot,
+                                   jnp.max(jnp.where(is_accept, inbox.ballot, NO_BALLOT))),
+        max_recv_ballot=jnp.maximum(state.max_recv_ballot,
+                                    jnp.max(jnp.where(is_accept, inbox.ballot, NO_BALLOT))),
+        # followers track the log extent so a later election starts
+        # assigning after everything they've seen (the reference keeps
+        # crtInstance on followers the same way)
+        crt_inst=jnp.maximum(
+            state.crt_inst, jnp.max(jnp.where(acc_ok, inbox.inst, -1)) + 1),
+    )
+    # ack every ACCEPT row (ok=0 NACK carries our promised ballot)
+    out = out._replace(
+        kind=jnp.where(is_accept, int(MsgKind.ACCEPT_REPLY), out.kind),
+        src=jnp.where(is_accept, state.me, out.src),
+        inst=jnp.where(is_accept, inbox.inst, out.inst),
+        ballot=jnp.where(is_accept, state.default_ballot, out.ballot),
+        op=jnp.where(is_accept, acc_ok.astype(jnp.int32), out.op),  # op = ok flag
+        last_committed=jnp.where(is_accept, state.committed_upto, out.last_committed),
+    )
+    dst = jnp.where(is_accept, inbox.src, dst)
+
+    # follower commit frontier from piggybacked LastCommitted
+    # (bareminpaxos.go:856-910 semantics without a Commit broadcast).
+    # Only rows at our current global ballot count: after a leader
+    # change, slots accepted under an older ballot must be re-confirmed
+    # by the new leader's catch-up before they may commit (the
+    # reference gets this implicitly from its single-leader stream
+    # ordering; with batched mixed-kind inboxes it must be explicit).
+    # COMMIT_SHORT rows carry the frontier in last_committed (the
+    # leader's explicit frontier broadcast, see step 9).
+    lc = jnp.max(jnp.where((is_accept | is_commit | is_cshort)
+                           & (inbox.ballot >= state.default_ballot),
+                           inbox.last_committed, -1))
+
+    # ---- 3. COMMIT rows (explicit per-slot commit, cold path) ----
+    rel_c, in_win_c = _rel(state, inbox.inst, S)
+    com_ok = is_commit & in_win_c
+    tgt_c = jnp.where(com_ok, rel_c, S)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_c].set(inbox.ballot, mode="drop"),
+        status=state.status.at[tgt_c].max(COMMITTED, mode="drop"),
+        op=state.op.at[tgt_c].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt_c].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt_c].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt_c].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt_c].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt_c].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt_c].set(inbox.client_id, mode="drop"),
+        crt_inst=jnp.maximum(
+            state.crt_inst, jnp.max(jnp.where(com_ok, inbox.inst, -1)) + 1),
+    )
+
+    # ---- 4. PREPARE_REPLY (handlePrepareReply :912-966) ----
+    pr_ok = (
+        is_prep_reply
+        & (inbox.ballot == state.default_ballot)
+        & (inbox.op > 0)
+        & state.is_leader
+    )
+    state = state._replace(
+        prepare_oks=state.prepare_oks.at[jnp.where(pr_ok, inbox.src, R)].set(
+            True, mode="drop"),
+        max_recv_ballot=jnp.maximum(
+            state.max_recv_ballot,
+            jnp.max(jnp.where(is_prep_reply, inbox.ballot, NO_BALLOT))),
+        # learn how far peers' logs extend so new proposals don't collide
+        crt_inst=jnp.maximum(
+            state.crt_inst, jnp.max(jnp.where(pr_ok, inbox.inst, -1))),
+    )
+    state = state._replace(
+        prepared=state.prepared
+        | (state.is_leader & (state.prepare_oks.sum() >= majority)),
+    )
+
+    # ---- 5. PROPOSE (handlePropose :617-710) ----
+    can_serve = state.is_leader & state.prepared
+    prop = is_propose & can_serve
+    # slot assignment: prefix count over propose rows
+    slot_off = jnp.cumsum(prop.astype(jnp.int32)) - 1
+    slots = state.crt_inst + slot_off
+    rel_p = slots - state.window_base
+    fits = prop & (rel_p >= 0) & (rel_p < S)
+    tgt_p = jnp.where(fits, rel_p, S)
+    self_vote = jax.nn.one_hot(state.me, R, dtype=bool)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_p].set(state.default_ballot, mode="drop"),
+        status=state.status.at[tgt_p].set(ACCEPTED, mode="drop"),
+        op=state.op.at[tgt_p].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt_p].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt_p].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt_p].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt_p].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt_p].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt_p].set(inbox.client_id, mode="drop"),
+        votes=state.votes.at[tgt_p].set(
+            jnp.broadcast_to(self_vote, (M, R)), mode="drop"),
+        crt_inst=state.crt_inst + jnp.where(fits, 1, 0).sum(),
+    )
+    # broadcast ACCEPT rows for accepted proposals; rejection replies
+    # (ProposeReplyTS{FALSE, Leader} :618-625) for the rest
+    reject = is_propose & ~fits
+    out = out._replace(
+        kind=jnp.where(fits, int(MsgKind.ACCEPT),
+                       jnp.where(reject, int(MsgKind.PROPOSE_REPLY), out.kind)),
+        src=jnp.where(is_propose, state.me, out.src),
+        inst=jnp.where(fits, slots, out.inst),
+        ballot=jnp.where(fits, state.default_ballot,
+                         jnp.where(reject, state.leader_id, out.ballot)),
+        last_committed=jnp.where(fits, state.committed_upto, out.last_committed),
+        op=jnp.where(fits, inbox.op, jnp.where(reject, 0, out.op)),
+        key_hi=jnp.where(is_propose, inbox.key_hi, out.key_hi),
+        key_lo=jnp.where(is_propose, inbox.key_lo, out.key_lo),
+        val_hi=jnp.where(is_propose, inbox.val_hi, out.val_hi),
+        val_lo=jnp.where(is_propose, inbox.val_lo, out.val_lo),
+        cmd_id=jnp.where(is_propose, inbox.cmd_id, out.cmd_id),
+        client_id=jnp.where(is_propose, inbox.client_id, out.client_id),
+    )
+    dst = jnp.where(fits, -1, jnp.where(reject, -2, dst))  # -2 = to client
+
+    # ---- 6. ACCEPT_REPLY (handleAcceptReply :1014-1064) ----
+    rel_r, in_win_r = _rel(state, inbox.inst, S)
+    ar_ok = is_accept_reply & in_win_r & (inbox.op > 0) & state.is_leader \
+        & (inbox.ballot == state.default_ballot)
+    tgt_r = jnp.where(ar_ok, rel_r, S)
+    reply_src = jnp.where(is_accept_reply | is_prep_reply,
+                          jnp.clip(inbox.src, 0, R - 1), R)
+    state = state._replace(
+        votes=state.votes.at[tgt_r, jnp.clip(inbox.src, 0, R - 1)].set(
+            True, mode="drop"),
+        max_recv_ballot=jnp.maximum(
+            state.max_recv_ballot,
+            jnp.max(jnp.where(is_accept_reply, inbox.ballot, NO_BALLOT))),
+        peer_commits=state.peer_commits.at[reply_src].max(
+            inbox.last_committed, mode="drop"),
+    )
+
+    # ---- 7. commit scan ----
+    idx_abs = state.window_base + jnp.arange(S, dtype=jnp.int32)
+    n_votes = state.votes.sum(axis=1)
+    leader_commit = state.is_leader & (state.status == ACCEPTED) & (
+        n_votes >= majority) & (state.ballot == state.default_ballot)
+    follower_commit = (state.status == ACCEPTED) & (idx_abs <= lc) & (
+        state.ballot == state.default_ballot)
+    state = state._replace(
+        status=jnp.where(leader_commit | follower_commit,
+                         COMMITTED, state.status))
+    start_rel = state.committed_upto + 1 - state.window_base
+    frontier_rel = commit_frontier(state.status >= COMMITTED, start_rel)
+    old_upto = state.committed_upto
+    state = state._replace(
+        committed_upto=jnp.maximum(state.committed_upto,
+                                   frontier_rel + state.window_base))
+
+    # ---- 7b. frontier broadcast + stall tracking ----
+    # The reference's followers only learn commitment from the NEXT
+    # Accept's piggyback (SURVEY.md section 3.2), stalling their exec
+    # cursor when traffic pauses. Here the leader appends one broadcast
+    # COMMIT_SHORT row whenever its frontier advances; cost is one row.
+    advanced = state.is_leader & (state.committed_upto > old_upto)
+    in_flight = state.crt_inst - 1 > state.committed_upto
+    state = state._replace(
+        tick=state.tick + 1,
+        stall_ticks=jnp.where(
+            state.is_leader & state.prepared & in_flight & ~advanced,
+            state.stall_ticks + 1, 0))
+    fb = MsgBatch.empty(1)
+    fb = fb._replace(
+        kind=jnp.where(advanced, int(MsgKind.COMMIT_SHORT), 0)[None].astype(
+            jnp.int32),
+        src=jnp.full(1, state.me, jnp.int32),
+        ballot=jnp.full(1, state.default_ballot, jnp.int32),
+        last_committed=jnp.full(1, state.committed_upto, jnp.int32),
+    )
+
+    # ---- 7c. catch-up (CatchUpLog, bareminpaxos.go:488-513) ----
+    # One peer per step, round-robin: if its known frontier trails
+    # ours, append up to `catchup_rows` committed slots as ACCEPT rows
+    # at the current ballot. A revived replica is healed within
+    # O(gap / catchup_rows * R) steps; the piggybacked frontier commits
+    # the rows on arrival.
+    K = cfg.catchup_rows
+    peer = jnp.mod(state.tick, R)
+    lagging = state.peer_commits[peer] < state.committed_upto
+    do_cu = state.is_leader & state.prepared & (peer != state.me) & lagging
+    cu_slots = state.peer_commits[peer] + 1 + jnp.arange(K, dtype=jnp.int32)
+    cu_rel = cu_slots - state.window_base
+    cu_ok = do_cu & (cu_slots <= state.committed_upto) & (cu_rel >= 0) & (
+        cu_rel < S)
+    cu_rel_safe = jnp.clip(cu_rel, 0, S - 1)
+    cu = MsgBatch(
+        kind=jnp.where(cu_ok, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
+        src=jnp.full(K, state.me, jnp.int32),
+        ballot=jnp.full(K, state.default_ballot, jnp.int32),
+        inst=cu_slots,
+        last_committed=jnp.full(K, state.committed_upto, jnp.int32),
+        op=state.op[cu_rel_safe],
+        key_hi=state.key_hi[cu_rel_safe],
+        key_lo=state.key_lo[cu_rel_safe],
+        val_hi=state.val_hi[cu_rel_safe],
+        val_lo=state.val_lo[cu_rel_safe],
+        cmd_id=state.cmd_id[cu_rel_safe],
+        client_id=state.client_id[cu_rel_safe],
+    )
+
+    # ---- 7d. in-flight retry + gap no-op fill ----
+    # When the frontier stalls (lost accepts, leader change), rebroad-
+    # cast the first `catchup_rows` uncommitted slots at the current
+    # ballot. Slots still EMPTY after `noop_delay` stalled steps (no
+    # live replica reported a value during recovery) are filled with
+    # no-ops — the classic new-leader gap fill; the reference's
+    # equivalent half-finished path is flagged in SURVEY.md section
+    # 7.4.
+    do_rt = state.is_leader & state.prepared & (state.stall_ticks >= 1)
+    rt_slots = state.committed_upto + 1 + jnp.arange(K, dtype=jnp.int32)
+    rt_rel = rt_slots - state.window_base
+    rt_rel_safe = jnp.clip(rt_rel, 0, S - 1)
+    rt_in = do_rt & (rt_slots < state.crt_inst) & (rt_rel >= 0) & (rt_rel < S)
+    rt_empty = rt_in & (state.status[rt_rel_safe] == NONE)
+    noop_fill = rt_empty & (state.stall_ticks >= cfg.noop_delay)
+    rt_ok = rt_in & ((state.status[rt_rel_safe] >= ACCEPTED) | noop_fill)
+    # bump retried slots to the current ballot (resetting votes when
+    # the ballot actually changes), so follower acks count
+    bump = rt_ok & (state.ballot[rt_rel_safe] != state.default_ballot)
+    tgt_b = jnp.where(bump, rt_rel, S)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_b].set(state.default_ballot, mode="drop"),
+        status=state.status.at[jnp.where(noop_fill, rt_rel, S)].set(
+            ACCEPTED, mode="drop"),
+        op=state.op.at[jnp.where(noop_fill, rt_rel, S)].set(0, mode="drop"),
+        cmd_id=state.cmd_id.at[jnp.where(noop_fill, rt_rel, S)].set(
+            0, mode="drop"),
+        client_id=state.client_id.at[jnp.where(noop_fill, rt_rel, S)].set(
+            -1, mode="drop"),
+        votes=state.votes.at[tgt_b].set(
+            jnp.broadcast_to(jax.nn.one_hot(state.me, R, dtype=bool), (K, R)),
+            mode="drop"),
+    )
+    rt = MsgBatch(
+        kind=jnp.where(rt_ok, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
+        src=jnp.full(K, state.me, jnp.int32),
+        ballot=jnp.full(K, state.default_ballot, jnp.int32),
+        inst=rt_slots,
+        last_committed=jnp.full(K, state.committed_upto, jnp.int32),
+        op=state.op[rt_rel_safe],
+        key_hi=state.key_hi[rt_rel_safe],
+        key_lo=state.key_lo[rt_rel_safe],
+        val_hi=state.val_hi[rt_rel_safe],
+        val_lo=state.val_lo[rt_rel_safe],
+        cmd_id=state.cmd_id[rt_rel_safe],
+        client_id=state.client_id[rt_rel_safe],
+    )
+
+    out = _concat_rows(_concat_rows(_concat_rows(_concat_rows(out, rec), fb), cu), rt)
+    dst = jnp.concatenate([
+        dst,
+        jnp.full(K2, prep_src, jnp.int32),  # recovery suffix -> new leader
+        jnp.full(1, -1, jnp.int32),  # frontier broadcast
+        jnp.full(K, peer, jnp.int32),  # catch-up -> laggard
+        jnp.full(K, -1, jnp.int32),  # retry broadcast
+    ])
+
+    # ---- 8. execute (executeCommands :1066-1098) ----
+    E = cfg.exec_batch
+    avail = state.committed_upto - state.executed_upto
+    n_exec = jnp.clip(avail, 0, E)
+    exec_lo = state.executed_upto + 1
+    rel_e = exec_lo - state.window_base + jnp.arange(E, dtype=jnp.int32)
+    evalid = jnp.arange(E) < n_exec
+    rel_e_safe = jnp.clip(rel_e, 0, S - 1)
+    kv, o_hi, o_lo, o_found = kv_apply_batch(
+        state.kv,
+        jnp.where(evalid, state.op[rel_e_safe], 0),
+        state.key_hi[rel_e_safe],
+        state.key_lo[rel_e_safe],
+        state.val_hi[rel_e_safe],
+        state.val_lo[rel_e_safe],
+        evalid,
+    )
+    state = state._replace(
+        kv=kv,
+        executed_upto=state.executed_upto + n_exec,
+        status=jnp.where(
+            (jnp.zeros(S, bool).at[jnp.where(evalid, rel_e, S)].set(
+                True, mode="drop")),
+            EXECUTED, state.status),
+    )
+    execr = ExecResult(
+        lo=exec_lo, count=n_exec, val_hi=o_hi, val_lo=o_lo, found=o_found,
+        op=jnp.where(evalid, state.op[rel_e_safe], 0),
+        cmd_id=jnp.where(evalid, state.cmd_id[rel_e_safe], 0),
+        client_id=jnp.where(evalid, state.client_id[rel_e_safe], 0),
+    )
+    return state, Outbox(msgs=out, dst=dst), execr
+
+
+# Single-replica entry point used by the host runtime (runtime/replica.py).
+replica_step = jax.jit(replica_step_impl, static_argnums=0, donate_argnums=1)

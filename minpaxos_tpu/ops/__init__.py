@@ -1,0 +1,15 @@
+from minpaxos_tpu.ops.scan import segmented_scan_max, exclusive_segmented_scan_max, commit_frontier
+from minpaxos_tpu.ops.packed import split_i64, join_i64
+from minpaxos_tpu.ops.kvstore import KVState, kv_init, kv_lookup, kv_apply_batch
+
+__all__ = [
+    "segmented_scan_max",
+    "exclusive_segmented_scan_max",
+    "commit_frontier",
+    "split_i64",
+    "join_i64",
+    "KVState",
+    "kv_init",
+    "kv_lookup",
+    "kv_apply_batch",
+]

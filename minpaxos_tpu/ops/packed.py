@@ -1,0 +1,52 @@
+"""64-bit keys/values as pairs of 32-bit lanes.
+
+The reference's state machine uses int64 keys and values
+(state/state.go:27-31). TPUs are 32-bit-native: JAX defaults to i32 and
+int64 arithmetic is emulated. Rather than enable x64 globally, device
+code carries every 64-bit quantity as (hi: i32, lo: i32) lane pairs —
+host code splits/joins at the wire boundary. Equality, hashing and
+selection (all the state machine needs; it never does arithmetic on
+keys or values) are cheap on pairs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def split_i64(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: int64 array -> (hi i32, lo i32) with lo holding the
+    low 32 bits reinterpreted as signed."""
+    x = np.asarray(x, dtype=np.int64)
+    hi = (x >> 32).astype(np.int32)
+    lo = (x & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+    return hi, lo
+
+
+def join_i64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Host-side inverse of split_i64."""
+    hi = np.asarray(hi, dtype=np.int64)
+    lo = np.asarray(lo).astype(np.int32).view(np.uint32).astype(np.int64)
+    return (hi << 32) | lo
+
+
+def pair_eq(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi == b_hi) & (a_lo == b_lo)
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer on uint32 lanes."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def pair_hash(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    """uint32 hash of an (hi, lo) pair, suitable for table indexing."""
+    h = _mix32(lo.astype(jnp.uint32) ^ jnp.uint32(0x9E3779B9))
+    h = _mix32(h ^ hi.astype(jnp.uint32))
+    return h

@@ -8,7 +8,7 @@ multi-chip path via __graft_entry__.dryrun_multichip).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +16,11 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The container's sitecustomize force-registers the TPU tunnel backend
+# ("axon") and pins jax_platforms; override before any backend init so
+# the suite runs on the virtual 8-device CPU mesh, not through the
+# (slow-compile) tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,124 @@
+"""KV engine vs a sequential dict oracle (reference state.Execute
+semantics, state/state.go:86-103)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init, kv_lookup
+from minpaxos_tpu.ops.packed import join_i64, split_i64
+from minpaxos_tpu.wire.messages import Op
+
+
+def _apply_np(kv, ops, keys, vals, valid=None):
+    ops = np.asarray(ops, dtype=np.int32)
+    k_hi, k_lo = split_i64(np.asarray(keys))
+    v_hi, v_lo = split_i64(np.asarray(vals))
+    if valid is None:
+        valid = np.ones(len(ops), dtype=bool)
+    kv, o_hi, o_lo, found = jax.jit(kv_apply_batch)(
+        kv, jnp.asarray(ops), jnp.asarray(k_hi), jnp.asarray(k_lo),
+        jnp.asarray(v_hi), jnp.asarray(v_lo), jnp.asarray(valid))
+    return kv, join_i64(np.asarray(o_hi), np.asarray(o_lo)), np.asarray(found)
+
+
+class DictOracle:
+    def __init__(self):
+        self.d = {}
+
+    def apply(self, ops, keys, vals, valid=None):
+        outs, founds = [], []
+        if valid is None:
+            valid = [True] * len(ops)
+        for op, k, v, ok in zip(ops, keys, vals, valid):
+            if not ok:
+                outs.append(0); founds.append(False); continue
+            if op == Op.PUT:
+                self.d[k] = v; outs.append(v); founds.append(True)
+            elif op == Op.GET:
+                outs.append(self.d.get(k, 0)); founds.append(k in self.d)
+            elif op == Op.DELETE:
+                self.d.pop(k, None); outs.append(0); founds.append(False)
+            else:
+                outs.append(0); founds.append(False)
+        return np.array(outs, dtype=np.int64), np.array(founds)
+
+
+def test_put_then_get_same_batch():
+    kv = kv_init(8)
+    ops = [Op.PUT, Op.GET, Op.PUT, Op.GET, Op.GET]
+    keys = [7, 7, 7, 7, 99]
+    vals = [10, 0, 20, 0, 0]
+    kv, out, found = _apply_np(kv, ops, keys, vals)
+    assert out.tolist() == [10, 10, 20, 20, 0]
+    assert found.tolist() == [True, True, True, True, False]
+
+
+def test_cross_batch_persistence():
+    kv = kv_init(8)
+    kv, _, _ = _apply_np(kv, [Op.PUT], [5], [55])
+    kv, out, found = _apply_np(kv, [Op.GET], [5], [0])
+    assert out[0] == 55 and found[0]
+
+
+def test_delete_semantics():
+    kv = kv_init(8)
+    kv, _, _ = _apply_np(kv, [Op.PUT, Op.DELETE, Op.GET], [1, 1, 1], [9, 0, 0])
+    kv, out, found = _apply_np(kv, [Op.GET], [1], [0])
+    assert not found[0] and out[0] == 0
+
+
+def test_64bit_keys_and_values():
+    kv = kv_init(8)
+    k = 0x1234_5678_9ABC_DEF0 - 2**63  # negative i64
+    v = 2**62 + 12345
+    kv, out, found = _apply_np(kv, [Op.PUT, Op.GET], [k, k], [v, 0])
+    assert out[1] == v and found[1]
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_random_vs_oracle(seed):
+    rng = np.random.default_rng(seed)
+    kv = kv_init(12)  # 4096 slots
+    oracle = DictOracle()
+    for _ in range(5):
+        b = int(rng.integers(1, 300))
+        ops = rng.choice([Op.PUT, Op.GET, Op.DELETE], size=b, p=[0.5, 0.4, 0.1])
+        keys = rng.integers(-50, 50, size=b).astype(np.int64)
+        vals = rng.integers(-(2**60), 2**60, size=b).astype(np.int64)
+        valid = rng.random(b) < 0.9
+        kv, out, found = _apply_np(kv, ops, keys, vals, valid)
+        want_out, want_found = oracle.apply(ops, keys, vals, valid)
+        np.testing.assert_array_equal(out, want_out)
+        np.testing.assert_array_equal(found, want_found)
+        assert int(np.asarray(kv.dropped)) == 0
+    # final table state agrees with the oracle
+    ks = np.array(sorted(oracle.d), dtype=np.int64)
+    if len(ks):
+        k_hi, k_lo = split_i64(ks)
+        f, v_hi, v_lo = jax.jit(kv_lookup)(kv, jnp.asarray(k_hi), jnp.asarray(k_lo))
+        assert np.asarray(f).all()
+        np.testing.assert_array_equal(
+            join_i64(np.asarray(v_hi), np.asarray(v_lo)),
+            np.array([oracle.d[k] for k in ks]))
+
+
+def test_put_delete_churn_reuses_capacity():
+    # delete-in-place: churn on one key must not consume table slots
+    kv = kv_init(4)  # 16 slots
+    for i in range(40):
+        kv, _, _ = _apply_np(kv, [Op.PUT, Op.DELETE], [7, 7], [i, 0])
+    kv, out, found = _apply_np(kv, [Op.PUT, Op.GET], [7, 7], [99, 0])
+    assert found[1] and out[1] == 99
+    assert int(np.asarray(kv.dropped)) == 0
+
+
+def test_probe_chain_with_collisions():
+    # tiny table (16 slots) + more distinct keys than half capacity
+    kv = kv_init(4)
+    keys = np.arange(12, dtype=np.int64) * 1000
+    kv, out, found = _apply_np(kv, [Op.PUT] * 12, keys, keys + 1)
+    kv, out, found = _apply_np(kv, [Op.GET] * 12, keys, np.zeros(12))
+    assert found.all()
+    assert (out == keys + 1).all()

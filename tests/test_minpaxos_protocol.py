@@ -1,0 +1,130 @@
+"""MinPaxos protocol tests over the pod-mode cluster.
+
+Programmatic equivalents of the reference's shell matrix (SURVEY.md
+section 4): simpletest.sh smoke, exactly-once -check semantics
+(client.go:279-284), leader kill + election
+(leaderelectiontestmaster.sh), and the agreement invariant the TLA+
+spec states (EgalitarianPaxos.tla:708 Consistency).
+"""
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.cluster import Cluster, tree_slice
+from minpaxos_tpu.models.minpaxos import COMMITTED, MinPaxosConfig
+from minpaxos_tpu.wire.messages import Op
+
+CFG = MinPaxosConfig(n_replicas=3, window=256, inbox=512, exec_batch=128,
+                     kv_pow2=10)
+
+
+def boot(cfg=CFG) -> Cluster:
+    c = Cluster(cfg, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    return c
+
+
+def test_boot_elects_leader():
+    c = boot()
+    st0 = tree_slice(c.cs.states, 0)
+    assert bool(np.asarray(st0.prepared))
+    assert c.leader == 0
+    for r in range(3):
+        assert int(np.asarray(tree_slice(c.cs.states, r).leader_id)) == 0
+
+
+def test_basic_put_get_commit():
+    c = boot()
+    c.propose(ops=[Op.PUT, Op.PUT, Op.GET], keys=[1, 2, 1], vals=[10, 20, 0],
+              cmd_ids=[0, 1, 2], client_id=7)
+    c.run(4)
+    assert c.replies[(7, 0)]["value"] == 10
+    assert c.replies[(7, 1)]["value"] == 20
+    assert c.replies[(7, 2)]["value"] == 10 and c.replies[(7, 2)]["found"]
+    # all replicas converge on the same committed frontier
+    for r in range(3):
+        st = tree_slice(c.cs.states, r)
+        assert int(np.asarray(st.committed_upto)) == 2
+
+
+def test_exactly_once_large_batch():
+    c = boot()
+    n = 200
+    c.propose(ops=[Op.PUT] * n, keys=list(range(n)), vals=[k * 3 for k in range(n)],
+              cmd_ids=list(range(n)), client_id=1)
+    c.run(5)
+    assert len(c.replies) == n
+    dups = [e for e in c.reply_log if e.get("duplicate")]
+    assert not dups
+    for i in range(n):
+        assert c.replies[(1, i)]["value"] == i * 3
+
+
+def test_agreement_across_replicas():
+    c = boot()
+    rng = np.random.default_rng(0)
+    for batch in range(3):
+        n = 50
+        c.propose(ops=rng.choice([Op.PUT, Op.GET], n), keys=rng.integers(0, 20, n),
+                  vals=rng.integers(0, 100, n), cmd_ids=np.arange(n) + batch * n,
+                  client_id=2)
+        c.run(4)
+    frontiers = []
+    logs = []
+    for r in range(3):
+        st = tree_slice(c.cs.states, r)
+        f = int(np.asarray(st.committed_upto))
+        frontiers.append(f)
+        logs.append((np.asarray(st.op)[: f + 1], np.asarray(st.key_lo)[: f + 1],
+                     np.asarray(st.val_lo)[: f + 1], np.asarray(st.cmd_id)[: f + 1]))
+    assert min(frontiers) >= 0
+    # committed prefixes agree slot-by-slot (Consistency)
+    lo = min(frontiers) + 1
+    for r in range(1, 3):
+        for a, b in zip(logs[0], logs[r]):
+            np.testing.assert_array_equal(a[:lo], b[:lo])
+
+
+def test_leader_failover():
+    c = boot()
+    c.propose(ops=[Op.PUT], keys=[5], vals=[50], cmd_ids=[0], client_id=3)
+    c.run(4)
+    assert c.replies[(3, 0)]["value"] == 50
+    # kill the leader; master promotes replica 1 (real Prepare round)
+    c.kill(0)
+    c.elect(1)
+    c.run(3)
+    st1 = tree_slice(c.cs.states, 1)
+    assert bool(np.asarray(st1.prepared))
+    c.propose(ops=[Op.GET], keys=[5], vals=[0], cmd_ids=[1], client_id=3, to=1)
+    c.run(4)
+    assert c.replies[(3, 1)]["value"] == 50 and c.replies[(3, 1)]["found"]
+    # replica 2 followed the new leader
+    st2 = tree_slice(c.cs.states, 2)
+    assert int(np.asarray(st2.leader_id)) == 1
+
+
+def test_propose_to_follower_rejected_with_leader_hint():
+    c = boot()
+    c.propose(ops=[Op.PUT], keys=[9], vals=[90], cmd_ids=[0], client_id=4, to=2)
+    c.run(3)
+    rej = [e for e in c.reply_log if e.get("ok") is False]
+    assert rej and rej[0]["leader"] == 0  # ProposeReplyTS.Leader re-routing
+    assert (4, 0) not in c.replies
+
+
+def test_dead_replica_stalls_then_recovers():
+    cfg = CFG
+    c = boot(cfg)
+    c.kill(2)
+    # majority (2 of 3) still commits
+    c.propose(ops=[Op.PUT], keys=[1], vals=[11], cmd_ids=[0], client_id=5)
+    c.run(4)
+    assert c.replies[(5, 0)]["value"] == 11
+    # revive: catches up via the next accept's piggybacked frontier
+    c.revive(2)
+    c.propose(ops=[Op.PUT], keys=[2], vals=[22], cmd_ids=[1], client_id=5)
+    c.run(4)
+    st2 = tree_slice(c.cs.states, 2)
+    assert int(np.asarray(st2.committed_upto)) >= 0

@@ -1,0 +1,124 @@
+"""Randomized fault-schedule safety tests.
+
+Drives the pod-mode cluster through random mixes of proposals, leader
+kills, elections, and revivals, then checks the Paxos safety
+invariants the TLA+ spec names (EgalitarianPaxos.tla:687-708):
+
+- Consistency: no two replicas disagree on any committed slot's
+  command.
+- Stability: a slot once committed on a replica never changes there.
+- Exactly-once: every successful reply is delivered at most once per
+  (client, cmd_id) (the reference's client -check, client.go:279-284).
+
+Liveness is NOT asserted under arbitrary faults (a majority can be
+dead); only safety must hold unconditionally.
+"""
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.cluster import Cluster, tree_slice
+from minpaxos_tpu.models.minpaxos import COMMITTED, MinPaxosConfig
+from minpaxos_tpu.wire.messages import Op
+
+CFG = MinPaxosConfig(n_replicas=3, window=512, inbox=512, exec_batch=128,
+                     kv_pow2=10, catchup_rows=32)
+
+
+def snapshot_committed(c: Cluster, r: int):
+    st = tree_slice(c.cs.states, r)
+    upto = int(np.asarray(st.committed_upto))
+    if upto < 0:
+        return {}
+    sl = slice(0, upto + 1)
+    return {
+        "upto": upto,
+        "op": np.asarray(st.op)[sl].copy(),
+        "key": np.asarray(st.key_lo)[sl].copy(),
+        "val": np.asarray(st.val_lo)[sl].copy(),
+        "cmd": np.asarray(st.cmd_id)[sl].copy(),
+        "cli": np.asarray(st.client_id)[sl].copy(),
+    }
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33])
+def test_random_fault_schedule_safety(seed):
+    rng = np.random.default_rng(seed)
+    c = Cluster(CFG, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    stable: dict[int, dict[int, tuple]] = {r: {} for r in range(3)}
+    next_cmd = 0
+
+    for round_ in range(30):
+        action = rng.random()
+        alive = np.asarray(c.cs.alive)
+        if action < 0.55:
+            n = int(rng.integers(1, 40))
+            c.propose(
+                ops=rng.choice([Op.PUT, Op.GET], n),
+                keys=rng.integers(0, 30, n),
+                vals=rng.integers(1, 1000, n),
+                cmd_ids=np.arange(next_cmd, next_cmd + n),
+                client_id=1,
+                to=c.leader if alive[c.leader] else int(np.argmax(alive)),
+            )
+            next_cmd += n
+        elif action < 0.70 and alive.sum() > 2:
+            c.kill(int(rng.choice(np.nonzero(alive)[0])))
+        elif action < 0.85 and not alive.all():
+            c.revive(int(rng.choice(np.nonzero(~alive)[0])))
+        else:
+            cand = np.nonzero(alive)[0]
+            c.elect(int(rng.choice(cand)))
+        c.run(int(rng.integers(1, 4)))
+
+        # ---- invariants after every round ----
+        snaps = [snapshot_committed(c, r) for r in range(3)]
+        # Stability: committed slots never change
+        for r, snap in enumerate(snaps):
+            if not snap:
+                continue
+            for i in range(snap["upto"] + 1):
+                entry = (snap["op"][i], snap["key"][i], snap["val"][i],
+                         snap["cmd"][i], snap["cli"][i])
+                if i in stable[r]:
+                    assert stable[r][i] == entry, (
+                        f"seed {seed} round {round_}: replica {r} slot {i} "
+                        f"changed after commit: {stable[r][i]} -> {entry}")
+                else:
+                    stable[r][i] = entry
+        # Consistency: replicas agree on common committed prefix
+        for ra in range(3):
+            for rb in range(ra + 1, 3):
+                if not snaps[ra] or not snaps[rb]:
+                    continue
+                lo = min(snaps[ra]["upto"], snaps[rb]["upto"]) + 1
+                for fld in ("op", "key", "val", "cmd", "cli"):
+                    np.testing.assert_array_equal(
+                        snaps[ra][fld][:lo], snaps[rb][fld][:lo],
+                        err_msg=f"seed {seed} round {round_}: "
+                                f"replicas {ra}/{rb} diverge on {fld}")
+
+    # Exactly-once across the whole run
+    dups = [e for e in c.reply_log if e.get("duplicate")]
+    assert not dups, f"duplicate replies: {dups[:5]}"
+
+
+def test_revived_replica_full_value_agreement():
+    c = Cluster(CFG, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    c.kill(2)
+    n = 60
+    c.propose(ops=[Op.PUT] * n, keys=np.arange(n), vals=np.arange(n) * 7,
+              cmd_ids=np.arange(n), client_id=9)
+    c.run(5)
+    c.revive(2)
+    c.run(12)  # catch-up: 60 slots / 32 rows, peer visited every 3 ticks
+    st2 = tree_slice(c.cs.states, 2)
+    upto = int(np.asarray(st2.committed_upto))
+    assert upto == n - 1
+    np.testing.assert_array_equal(np.asarray(st2.val_lo)[:n], np.arange(n) * 7)
+    # and it executed the catch-up into its KV replica
+    assert int(np.asarray(st2.executed_upto)) == n - 1

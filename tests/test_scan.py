@@ -1,0 +1,47 @@
+"""Tests for ops/scan.py parallel primitives."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from minpaxos_tpu.ops.scan import (
+    commit_frontier,
+    exclusive_segmented_scan_max,
+    segmented_scan_max,
+)
+
+
+def _oracle_seg_max(values, seg_start):
+    out = np.empty_like(values)
+    cur = None
+    for i, (v, s) in enumerate(zip(values, seg_start)):
+        cur = v if (s or cur is None) else max(cur, v)
+        out[i] = cur
+    return out
+
+
+def test_segmented_scan_max_random():
+    rng = np.random.default_rng(0)
+    for n in (1, 2, 7, 64, 1000):
+        vals = rng.integers(-100, 100, n).astype(np.int32)
+        seg = rng.random(n) < 0.2
+        seg[0] = True
+        got = np.asarray(segmented_scan_max(jnp.asarray(vals), jnp.asarray(seg)))
+        assert (got == _oracle_seg_max(vals, seg)).all()
+
+
+def test_exclusive_segmented_scan_max():
+    vals = jnp.asarray(np.array([5, 1, 9, 2, 3, 8], dtype=np.int32))
+    seg = jnp.asarray(np.array([True, False, False, True, False, False]))
+    got = np.asarray(exclusive_segmented_scan_max(vals, seg, jnp.int32(-1)))
+    assert (got == np.array([-1, 5, 5, -1, 2, 3])).all()
+
+
+def test_commit_frontier():
+    c = jnp.asarray(np.array([1, 1, 1, 0, 1, 1], dtype=bool))
+    assert int(commit_frontier(c, jnp.int32(0))) == 2
+    assert int(commit_frontier(c, jnp.int32(3))) == 2
+    assert int(commit_frontier(c, jnp.int32(4))) == 5
+    allc = jnp.ones(8, dtype=bool)
+    assert int(commit_frontier(allc, jnp.int32(0))) == 7
+    none = jnp.zeros(8, dtype=bool)
+    assert int(commit_frontier(none, jnp.int32(0))) == -1
