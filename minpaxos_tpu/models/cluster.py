@@ -90,8 +90,7 @@ def _route(cfg: MinPaxosConfig, out_msgs: MsgBatch, dst: jnp.ndarray,
     return jax.vmap(inbox_for)(jnp.arange(r))
 
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
-def cluster_step(
+def cluster_step_impl(
     cfg: MinPaxosConfig, cs: ClusterState, ext: MsgBatch
 ) -> tuple[ClusterState, "ExecResult", MsgBatch, jnp.ndarray]:
     """One synchronous round: deliver pending + ext, step all replicas,
@@ -111,6 +110,11 @@ def cluster_step(
     client_rows = outbox.msgs
     client_mask = (outbox.dst == -2) & (outbox.msgs.kind != 0)
     return ClusterState(states, pending, cs.alive), execr, client_rows, client_mask
+
+
+# Jitted entry point for single-group (unsharded) pod mode; parallel/
+# sharded.py vmaps cluster_step_impl over a shard axis instead.
+cluster_step = jax.jit(cluster_step_impl, static_argnums=0, donate_argnums=1)
 
 
 class Cluster:

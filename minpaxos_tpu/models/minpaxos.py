@@ -66,6 +66,19 @@ class MinPaxosConfig(NamedTuple):
     catchup_rows: int = 64  # catch-up ACCEPT rows per step (CatchUpLog batch)
     recovery_rows: int = 256  # uncommitted-suffix rows shipped per PREPARE
     noop_delay: int = 8  # stalled steps before a gap slot is no-op filled
+    # Slide the window past the executed prefix each step, making the
+    # log unbounded like the reference's 15M preallocation
+    # (bareminpaxos.go:95) without unbounded device memory. Every
+    # replica retains up to `retention` executed slots so whoever is
+    # (or becomes) leader can heal laggards from resident state
+    # (CatchUpLog). LIMIT: a replica lagging beyond `retention` must be
+    # resynced from the durable log (runtime/ stable store — the
+    # reference's replay, bareminpaxos.go:122-161); until that runs,
+    # such a laggard stays frozen and must not be elected leader (the
+    # master elects the highest-frontier replica for this reason).
+    # Size retention to cover the longest expected outage.
+    slide_window: bool = True
+    retention: int = -1  # executed slots retained per replica; -1 = window//2
 
     @property
     def majority(self) -> int:
@@ -691,6 +704,50 @@ def replica_step_impl(
         cmd_id=jnp.where(evalid, state.cmd_id[rel_e_safe], 0),
         client_id=jnp.where(evalid, state.client_id[rel_e_safe], 0),
     )
+
+    # ---- 9. window slide ----
+    # Retire the executed prefix: roll every per-slot array left by the
+    # executed count and reset the freed tail, advancing window_base.
+    # This is how a fixed-size device window gives the reference's
+    # unbounded (15M-slot) log. All slot addressing is absolute with
+    # `_rel` translation, so in-flight messages are unaffected; rows
+    # addressing slid-out slots simply drop (they were executed).
+    if cfg.slide_window:
+        retention = cfg.retention if cfg.retention >= 0 else S // 2
+        others = jnp.arange(R) != state.me
+        peer_floor = jnp.min(
+            jnp.where(others, state.peer_commits, jnp.int32(2**30))) + 1
+        exec_edge = state.executed_upto + 1
+        # Everyone retains up to `retention` executed slots: any replica
+        # may become leader later and must be able to serve catch-up
+        # for that span. The current leader additionally holds slots
+        # the slowest peer still needs (within the same cap).
+        target = jnp.maximum(exec_edge - retention,
+                             jnp.where(state.is_leader,
+                                       jnp.minimum(exec_edge, peer_floor),
+                                       exec_edge - retention))
+        shift = jnp.clip(target - state.window_base, 0, S)
+        idx1 = jnp.arange(S, dtype=jnp.int32)
+        gone = idx1 >= (S - shift)
+
+        def slide(a, fill):
+            rolled = jnp.roll(a, -shift, axis=0)
+            m = gone if a.ndim == 1 else gone[:, None]
+            return jnp.where(m, fill, rolled)
+
+        state = state._replace(
+            ballot=slide(state.ballot, NO_BALLOT),
+            status=slide(state.status, NONE),
+            op=slide(state.op, 0),
+            key_hi=slide(state.key_hi, 0),
+            key_lo=slide(state.key_lo, 0),
+            val_hi=slide(state.val_hi, 0),
+            val_lo=slide(state.val_lo, 0),
+            cmd_id=slide(state.cmd_id, 0),
+            client_id=slide(state.client_id, 0),
+            votes=slide(state.votes, False),
+            window_base=state.window_base + shift,
+        )
     return state, Outbox(msgs=out, dst=dst), execr
 
 

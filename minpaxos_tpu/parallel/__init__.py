@@ -1,0 +1,26 @@
+"""Device-mesh parallelism: sharded-Paxos over ``jax.sharding.Mesh``.
+
+The reference's scaling axis is "more replica processes on more
+machines over TCP" (SURVEY.md section 2.5). The TPU-native scaling axes
+are array axes laid over a device mesh:
+
+* ``shard`` — independent Paxos groups (data parallelism over consensus
+  instances; the north-star 1024-shard config, BASELINE.md);
+* ``replica`` — the R replicas of one group (quorum communication
+  becomes XLA collectives over ICI instead of TCP).
+"""
+
+from minpaxos_tpu.parallel.mesh import make_mesh, shard_leading
+from minpaxos_tpu.parallel.sharded import (
+    ShardedCluster,
+    init_sharded,
+    sharded_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "shard_leading",
+    "ShardedCluster",
+    "init_sharded",
+    "sharded_step",
+]

@@ -1,0 +1,46 @@
+"""Mesh construction + sharding helpers.
+
+One place decides how logical axes (shard, replica) map onto hardware.
+Everything else takes a Mesh and PartitionSpecs — the standard JAX
+recipe: pick a mesh, annotate shardings, let XLA insert collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_shard_devices: int | None = None,
+    n_replica_devices: int = 1,
+    devices=None,
+) -> Mesh:
+    """A 2D ('shard', 'replica') mesh.
+
+    Default: all devices on the shard axis, replica axis size 1 (each
+    Paxos group fully resident on one chip — quorum math needs no
+    inter-chip traffic, the fastest layout). Set ``n_replica_devices``
+    > 1 to spread each group's replicas across chips, which turns the
+    message-routing gather in models/cluster.py into ICI collectives —
+    the deployment shape where replicas must not share a failure
+    domain.
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if n_shard_devices is None:
+        n_shard_devices = devices.size // n_replica_devices
+    devices = devices[: n_shard_devices * n_replica_devices]
+    grid = devices.reshape(n_shard_devices, n_replica_devices)
+    return Mesh(grid, axis_names=("shard", "replica"))
+
+
+def shard_leading(mesh: Mesh, tree, axis: str = "shard"):
+    """Place a pytree with ``device_put``, sharding every leaf's leading
+    axis along ``axis`` and replicating the rest."""
+
+    def put(x):
+        spec = P(axis) if getattr(x, "ndim", 0) >= 1 else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, tree)

@@ -1,0 +1,199 @@
+"""Sharded-Paxos: G independent consensus groups advanced by one jitted
+step, laid over the device mesh.
+
+The reference scales by adding replica processes (SURVEY.md section
+2.5); the instance *space* inside one group is a single Go array walked
+by one goroutine. Here the group itself is the data-parallel unit: the
+pod-mode cluster (models/cluster.py, leaves [R, ...]) gains a leading
+shard axis [G, R, ...], ``vmap`` runs every group's full protocol round
+simultaneously, and the ``shard`` mesh axis partitions G across chips.
+Groups never communicate — the same independence EPaxos exploits — so
+the partition introduces zero collectives on the shard axis; laying the
+``replica`` axis over chips instead turns the routing gather into ICI
+all-to-all (see parallel/mesh.py).
+
+This module is the north-star benchmark path (BASELINE.md: 1M
+concurrent instances = e.g. 1024 shards x 1024-slot windows, N=5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from minpaxos_tpu.models.cluster import (
+    ClusterState,
+    _tree_stack,
+    cluster_step_impl,
+    tree_slice,
+    tree_set,
+)
+from minpaxos_tpu.models.minpaxos import (
+    MinPaxosConfig,
+    MsgBatch,
+    become_leader,
+    init_replica,
+)
+from minpaxos_tpu.wire.messages import MsgKind, Op
+
+
+def _init_sharded(cfg: MinPaxosConfig, n_shards: int) -> ClusterState:
+    states = _tree_stack([init_replica(cfg, i) for i in range(cfg.n_replicas)])
+    # broadcast one zeroed group to all shards
+    def tile(x):
+        return jnp.broadcast_to(x[None], (n_shards,) + x.shape)
+
+    return ClusterState(
+        states=jax.tree_util.tree_map(tile, states),
+        pending=jax.tree_util.tree_map(
+            lambda x: jnp.zeros(
+                (n_shards, cfg.n_replicas) + x.shape, x.dtype),
+            MsgBatch.empty(cfg.inbox)),
+        alive=jnp.ones((n_shards, cfg.n_replicas), dtype=bool),
+    )
+
+
+def init_sharded(cfg: MinPaxosConfig, n_shards: int, mesh=None) -> ClusterState:
+    """All-shards cluster state, optionally placed along mesh axis
+    'shard' (leading-axis sharding; every group fully on one device).
+
+    With a mesh, the state is BORN sharded (jit out_shardings) — the
+    full [G, ...] tree never materializes on a single device, which
+    matters at north-star scale (1024 shards of KV tables would OOM one
+    chip)."""
+    if mesh is None:
+        return jax.jit(_init_sharded, static_argnums=(0, 1))(cfg, n_shards)
+    out_sharding = NamedSharding(mesh, P("shard"))  # prefix: all leaves
+    return jax.jit(_init_sharded, static_argnums=(0, 1),
+                   out_shardings=out_sharding)(cfg, n_shards)
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def sharded_step(cfg: MinPaxosConfig, ss: ClusterState, ext: MsgBatch):
+    """One synchronous round for every shard: [G, R, ...] in, same out.
+
+    ext is [G, R, Mext]. Returns (ss', exec results, client rows,
+    client mask) with a leading G axis. Input shardings propagate: with
+    ss/ext sharded on 'shard', XLA partitions the whole step with no
+    communication.
+    """
+    return jax.vmap(functools.partial(cluster_step_impl, cfg))(ss, ext)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def elect_all(cfg: MinPaxosConfig, ss: ClusterState, leader: int):
+    """Run become_leader for `leader` in EVERY shard and deposit the
+    PREPARE row into each peer's pending inbox (first free row, or row
+    0 if full — elections happen on quiet clusters; loss is legal
+    anyway, Paxos retries)."""
+
+    def one(cs: ClusterState) -> ClusterState:
+        st = tree_slice(cs.states, leader)
+        st, prep = become_leader(cfg, st)
+        states = tree_set(cs.states, leader, st)
+        row = jax.tree_util.tree_map(lambda x: x[0], prep)
+
+        free = jnp.argmin(cs.pending.kind, axis=1)  # [R] first kind==0
+        reps = jnp.arange(cfg.n_replicas)
+        is_peer = reps != leader
+
+        def put_col(col, v):
+            return col.at[reps, jnp.where(is_peer, free, -1)].set(
+                jnp.where(is_peer, v, col[reps, -1]))
+
+        pending = jax.tree_util.tree_map(
+            lambda col, v: put_col(col, v), cs.pending, row)
+        return ClusterState(states, pending, cs.alive)
+
+    return jax.vmap(one)(ss)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 6))
+def make_propose_ext(
+    cfg: MinPaxosConfig,
+    n_shards: int,
+    ext_rows: int,
+    count,
+    leader,
+    seed,
+    key_space: int = 1 << 20,
+) -> MsgBatch:
+    """Device-generated client workload: `count` PUT rows per shard,
+    addressed to the leader replica — the TPU equivalent of the
+    benchmark client's pre-generated request array
+    (reference client/client.go:68-103). Keys are hashed (shard, row,
+    seed) over `key_space`, the uniform-key mode; cmd_id encodes
+    (seed, row) for exactly-once auditing."""
+    g, r, m = n_shards, cfg.n_replicas, ext_rows
+    shard = jnp.arange(g, dtype=jnp.int32)[:, None, None]
+    rep = jnp.arange(r, dtype=jnp.int32)[None, :, None]
+    col = jnp.arange(m, dtype=jnp.int32)[None, None, :]
+    active = jnp.broadcast_to((rep == leader) & (col < count), (g, r, m))
+    mix = (shard * jnp.int32(40503) + col * jnp.int32(-1640531527)
+           + seed * jnp.int32(97)) & jnp.int32(key_space - 1)
+    z = jnp.zeros((g, r, m), jnp.int32)
+    return MsgBatch(
+        kind=jnp.where(active, int(MsgKind.PROPOSE), 0).astype(jnp.int32),
+        src=jnp.full((g, r, m), -1, jnp.int32),
+        ballot=z,
+        inst=z,
+        last_committed=z,
+        op=jnp.where(active, int(Op.PUT), 0).astype(jnp.int32),
+        key_hi=z,
+        key_lo=jnp.where(active, mix, 0),
+        val_hi=z,
+        val_lo=jnp.where(active, col + seed, 0),
+        cmd_id=jnp.where(active, seed * m + col, 0),
+        client_id=jnp.where(active, shard, 0),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def commit_totals(cfg: MinPaxosConfig, ss: ClusterState):
+    """(total committed instances across shards at the leader-0 view,
+    min committed_upto, max committed_upto) — the bench's progress
+    probe, one scalar transfer each."""
+    upto = ss.states.committed_upto[:, 0]
+    return (upto + 1).sum(), upto.min(), upto.max()
+
+
+class ShardedCluster:
+    """Host wrapper for the sharded bench/tests: boot -> elect ->
+    feed device-generated proposals -> step. Mirrors models/cluster.py's
+    Cluster but with everything hot staying on device."""
+
+    def __init__(self, cfg: MinPaxosConfig, n_shards: int,
+                 ext_rows: int = 512, mesh=None):
+        self.cfg = cfg
+        self.n_shards = n_shards
+        self.ext_rows = ext_rows
+        self.mesh = mesh
+        self.ss = init_sharded(cfg, n_shards, mesh)
+        self.leader = 0
+        self._seed = 0
+
+    def elect(self, leader: int = 0) -> None:
+        self.ss = elect_all(self.cfg, self.ss, leader)
+        self.leader = leader
+        self.step(0)  # deliver PREPAREs
+        self.step(0)  # deliver replies -> leader prepared
+
+    def step(self, n_proposals: int) -> None:
+        ext = make_propose_ext(
+            self.cfg, self.n_shards, self.ext_rows,
+            jnp.int32(min(n_proposals, self.ext_rows)),
+            jnp.int32(self.leader), jnp.int32(self._seed))
+        if self.mesh is not None:
+            ext = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, NamedSharding(self.mesh, P("shard"))), ext)
+        self._seed += 1
+        self.ss, _, _, _ = sharded_step(self.cfg, self.ss, ext)
+
+    def committed(self) -> tuple[int, int, int]:
+        tot, lo, hi = commit_totals(self.cfg, self.ss)
+        return int(tot), int(lo), int(hi)

@@ -26,19 +26,19 @@ CFG = MinPaxosConfig(n_replicas=3, window=512, inbox=512, exec_batch=128,
 
 
 def snapshot_committed(c: Cluster, r: int):
+    """Committed slots still resident in the window, keyed by ABSOLUTE
+    slot number (windows slide past executed prefixes independently)."""
     st = tree_slice(c.cs.states, r)
     upto = int(np.asarray(st.committed_upto))
-    if upto < 0:
-        return {}
-    sl = slice(0, upto + 1)
-    return {
-        "upto": upto,
-        "op": np.asarray(st.op)[sl].copy(),
-        "key": np.asarray(st.key_lo)[sl].copy(),
-        "val": np.asarray(st.val_lo)[sl].copy(),
-        "cmd": np.asarray(st.cmd_id)[sl].copy(),
-        "cli": np.asarray(st.client_id)[sl].copy(),
-    }
+    base = int(np.asarray(st.window_base))
+    if upto < base:
+        return {"upto": upto, "entries": {}}
+    sl = slice(0, upto - base + 1)
+    cols = [np.asarray(a)[sl] for a in
+            (st.op, st.key_lo, st.val_lo, st.cmd_id, st.client_id)]
+    entries = {base + i: tuple(int(col[i]) for col in cols)
+               for i in range(upto - base + 1)}
+    return {"upto": upto, "entries": entries}
 
 
 @pytest.mark.parametrize("seed", [11, 22, 33])
@@ -48,6 +48,12 @@ def test_random_fault_schedule_safety(seed):
     c.elect(0)
     c.run(3)
     stable: dict[int, dict[int, tuple]] = {r: {} for r in range(3)}
+    # slot -> (first observer replica, value); all later observations
+    # from any replica must match (Consistency even when windows never
+    # overlap). Only CROSS-replica matches count toward the vacuity
+    # guard — same-replica re-observation is just Stability again.
+    agreed: dict[int, tuple[int, tuple]] = {}
+    compared = 0
     next_cmd = 0
 
     for round_ in range(30):
@@ -75,34 +81,37 @@ def test_random_fault_schedule_safety(seed):
 
         # ---- invariants after every round ----
         snaps = [snapshot_committed(c, r) for r in range(3)]
-        # Stability: committed slots never change
+        # Stability: committed slots never change (checked while the
+        # slot remains resident; slid-out slots were already verified)
         for r, snap in enumerate(snaps):
-            if not snap:
-                continue
-            for i in range(snap["upto"] + 1):
-                entry = (snap["op"][i], snap["key"][i], snap["val"][i],
-                         snap["cmd"][i], snap["cli"][i])
+            for i, entry in snap["entries"].items():
                 if i in stable[r]:
                     assert stable[r][i] == entry, (
                         f"seed {seed} round {round_}: replica {r} slot {i} "
                         f"changed after commit: {stable[r][i]} -> {entry}")
                 else:
                     stable[r][i] = entry
-        # Consistency: replicas agree on common committed prefix
-        for ra in range(3):
-            for rb in range(ra + 1, 3):
-                if not snaps[ra] or not snaps[rb]:
-                    continue
-                lo = min(snaps[ra]["upto"], snaps[rb]["upto"]) + 1
-                for fld in ("op", "key", "val", "cmd", "cli"):
-                    np.testing.assert_array_equal(
-                        snaps[ra][fld][:lo], snaps[rb][fld][:lo],
-                        err_msg=f"seed {seed} round {round_}: "
-                                f"replicas {ra}/{rb} diverge on {fld}")
+        # Consistency: every replica's observation of a committed slot
+        # matches the first observation recorded for that slot, by any
+        # replica, in any round — co-residency not required
+        for r, snap in enumerate(snaps):
+            for i, entry in snap["entries"].items():
+                if i in agreed:
+                    first_r, first_entry = agreed[i]
+                    assert first_entry == entry, (
+                        f"seed {seed} round {round_}: replica {r} slot {i} "
+                        f"disagrees with committed value: "
+                        f"{first_entry} vs {entry}")
+                    if r != first_r:
+                        compared += 1
+                else:
+                    agreed[i] = (r, entry)
 
     # Exactly-once across the whole run
     dups = [e for e in c.reply_log if e.get("duplicate")]
     assert not dups, f"duplicate replies: {dups[:5]}"
+    # the consistency check must actually have compared something
+    assert compared > 0, "Consistency check never fired (vacuous test)"
 
 
 def test_revived_replica_full_value_agreement():
@@ -119,6 +128,36 @@ def test_revived_replica_full_value_agreement():
     st2 = tree_slice(c.cs.states, 2)
     upto = int(np.asarray(st2.committed_upto))
     assert upto == n - 1
-    np.testing.assert_array_equal(np.asarray(st2.val_lo)[:n], np.arange(n) * 7)
-    # and it executed the catch-up into its KV replica
+    # and it executed the catch-up into its KV replica: every key holds
+    # the exact value the leader committed
     assert int(np.asarray(st2.executed_upto)) == n - 1
+    live = np.asarray(st2.kv.slot) == 1
+    got = dict(zip(np.asarray(st2.kv.key_lo)[live].tolist(),
+                   np.asarray(st2.kv.val_lo)[live].tolist()))
+    assert got == {int(k): int(k) * 7 for k in range(n)}
+
+
+def test_laggard_healed_by_new_leader_after_failover():
+    """Code-review regression: replica 2 falls behind, then the ORIGINAL
+    leader dies. The newly elected leader must still heal replica 2 from
+    its retained window (every replica keeps `retention` executed slots
+    resident for exactly this)."""
+    c = Cluster(CFG, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    c.kill(2)
+    n = 60
+    c.propose(ops=[Op.PUT] * n, keys=np.arange(n), vals=np.arange(n) * 5,
+              cmd_ids=np.arange(n), client_id=4)
+    c.run(6)
+    c.revive(2)
+    c.kill(0)
+    c.elect(1)
+    c.run(20)  # new leader's catch-up heals replica 2
+    st2 = tree_slice(c.cs.states, 2)
+    assert int(np.asarray(st2.committed_upto)) >= n - 1
+    assert int(np.asarray(st2.executed_upto)) >= n - 1
+    live = np.asarray(st2.kv.slot) == 1
+    got = dict(zip(np.asarray(st2.kv.key_lo)[live].tolist(),
+                   np.asarray(st2.kv.val_lo)[live].tolist()))
+    assert got == {int(k): int(k) * 5 for k in range(n)}
