@@ -1,0 +1,5 @@
+"""Process entry points: ``python -m minpaxos_tpu.cli.{master,server,client}``.
+
+Counterpart of the reference's binaries (src/master, src/server,
+src/client*, SURVEY.md section 2.1/2.4) with flag-compatible knobs.
+"""

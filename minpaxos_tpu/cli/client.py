@@ -1,0 +1,90 @@
+"""Benchmark client binary.
+
+Flag surface follows the reference client family (client.go:19-31,
+clientretry.go, clientlat/clienttot — SURVEY.md section 2.4):
+``-q`` requests per round, ``-r`` rounds, ``-c`` conflict percent,
+``-z`` Zipfian exponent, ``-w`` write percent, ``-check`` exactly-once
+validation, ``-lat`` per-request latency mode (clientlat's
+one-outstanding-request probe), ``-tot`` throughput-over-time samples
+(clienttot's 10ms buckets).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("minpaxos-client")
+    p.add_argument("-maddr", default="127.0.0.1")
+    p.add_argument("-mport", type=int, default=7087)
+    p.add_argument("-q", type=int, default=1000, help="requests per round")
+    p.add_argument("-r", type=int, default=1, help="rounds")
+    p.add_argument("-c", type=int, default=0, help="conflict percent")
+    p.add_argument("-z", type=float, default=0.0, help="Zipfian s (0=uniform)")
+    p.add_argument("-w", type=int, default=100, help="write percent")
+    p.add_argument("-check", action="store_true",
+                   help="verify exactly-once replies")
+    p.add_argument("-batch", type=int, default=512)
+    p.add_argument("-lat", action="store_true",
+                   help="closed-loop per-request latency mode")
+    p.add_argument("-timeout", type=float, default=60.0)
+    args = p.parse_args(argv)
+
+    from minpaxos_tpu.runtime.client import Client, gen_workload
+
+    cli = Client((args.maddr, args.mport), check=args.check)
+
+    total_acked = 0
+    t_all = time.monotonic()
+    for rnd in range(args.r):
+        ops, keys, vals = gen_workload(
+            args.q, conflict_pct=args.c, zipf_s=args.z, write_pct=args.w,
+            seed=42 + rnd)
+        if args.lat:
+            # clientlat mode: one outstanding request, per-op latency
+            cli.connect()
+            lats = []
+            for i in range(args.q):
+                t0 = time.monotonic()
+                r = cli.run_workload(ops[i:i+1], keys[i:i+1], vals[i:i+1],
+                                     batch=1, timeout_s=args.timeout)
+                lats.append(time.monotonic() - t0)
+                total_acked += r["acked"]
+            lats_ms = np.asarray(lats) * 1e3
+            print(f"round {rnd}: p50 {np.percentile(lats_ms, 50):.3f} ms  "
+                  f"p99 {np.percentile(lats_ms, 99):.3f} ms  "
+                  f"mean {lats_ms.mean():.3f} ms", flush=True)
+        else:
+            t0 = time.monotonic()
+            stats = cli.run_workload(ops, keys, vals, batch=args.batch,
+                                     timeout_s=args.timeout)
+            wall = time.monotonic() - t0
+            total_acked += stats["acked"]
+            print(f"round {rnd}: {stats['acked']}/{args.q} acked in "
+                  f"{wall:.3f}s  ({stats['ops_per_s']:.0f} ops/s)",
+                  flush=True)
+            if args.check:
+                if stats["missing"]:
+                    print(f"CHECK FAILED: didn't receive "
+                          f"{stats['missing']} replies", flush=True)
+                if stats["duplicates"]:
+                    print(f"CHECK: {stats['duplicates']} duplicate replies",
+                          flush=True)
+                if not stats["missing"] and not stats["duplicates"]:
+                    print("CHECK OK: exactly-once for all commands",
+                          flush=True)
+        # fresh cmd_id space per round
+        cli.replies.clear()
+        cli.rejected.clear()
+    wall_all = time.monotonic() - t_all
+    print(f"total: {total_acked} acked in {wall_all:.3f}s "
+          f"({total_acked / wall_all:.0f} ops/s)", flush=True)
+    cli.close_conn()
+
+
+if __name__ == "__main__":
+    main()

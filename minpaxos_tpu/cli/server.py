@@ -1,0 +1,96 @@
+"""Replica server binary — reference src/server/server.go flags (:19-34).
+
+The reference's protocol selector flags are honored: ``-min`` (MinPaxos,
+the default and only active path in the reference too — server.go:58-79
+has every other protocol commented out). ``-platform`` picks the JAX
+backend; the default is ``cpu`` because N replica processes on one host
+cannot share one TPU — pod mode (models/cluster.py) or the sharded mesh
+(parallel/) are the on-accelerator deployments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("minpaxos-server")
+    p.add_argument("-port", type=int, default=7070, help="data port")
+    p.add_argument("-addr", default="127.0.0.1", help="listen address")
+    p.add_argument("-maddr", default="127.0.0.1", help="master address")
+    p.add_argument("-mport", type=int, default=7087, help="master port")
+    p.add_argument("-min", action="store_true", default=True,
+                   help="use MinPaxos (global-ballot Multi-Paxos)")
+    p.add_argument("-exec", dest="exec_", action="store_true", default=True,
+                   help="execute committed commands")
+    p.add_argument("-dreply", action="store_true", default=True,
+                   help="reply after execution with the value")
+    p.add_argument("-durable", action="store_true",
+                   help="fsync accepted slots to the stable store")
+    p.add_argument("-thrifty", action="store_true",
+                   help="send accepts to a bare quorum only")
+    p.add_argument("-beacon", action="store_true",
+                   help="RTT beacons; thrifty prefers fastest peers")
+    p.add_argument("-window", type=int, default=1 << 14,
+                   help="resident log window slots")
+    p.add_argument("-inbox", type=int, default=4096,
+                   help="message rows per protocol tick")
+    p.add_argument("-storedir", default=".",
+                   help="stable store directory")
+    p.add_argument("-platform", default="cpu",
+                   help="jax platform for the replica step (cpu/tpu)")
+    p.add_argument("-cpuprofile", default="",
+                   help="write a profile dump on SIGINT (pprof-style)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+    from minpaxos_tpu.runtime.master import get_replica_list, register_with_master
+    from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
+
+    maddr = (args.maddr, args.mport)
+    my_id = register_with_master(maddr, args.addr, args.port)
+    nodes = get_replica_list(maddr)
+    print(f"server: registered as replica {my_id} of {len(nodes)}",
+          flush=True)
+
+    cfg = MinPaxosConfig(
+        n_replicas=len(nodes), window=args.window, inbox=args.inbox,
+        exec_batch=args.inbox, kv_pow2=16,
+        catchup_rows=256, recovery_rows=256)
+    flags = RuntimeFlags(exec_=args.exec_, dreply=args.dreply,
+                         durable=args.durable, thrifty=args.thrifty,
+                         beacon=args.beacon, store_dir=args.storedir)
+    server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags)
+
+    prof = None
+    if args.cpuprofile:
+        prof = cProfile.Profile()
+        prof.enable()
+
+    server.start()
+    print(f"server: replica {my_id} serving on {args.addr}:{args.port}",
+          flush=True)
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    if prof is not None:
+        prof.disable()
+        prof.dump_stats(args.cpuprofile)
+        print(f"server: profile written to {args.cpuprofile}", flush=True)
+    server.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

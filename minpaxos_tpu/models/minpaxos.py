@@ -176,17 +176,22 @@ class ReplicaState(NamedTuple):
 
 def init_replica(cfg: MinPaxosConfig, me: int) -> ReplicaState:
     s, r = cfg.window, cfg.n_replicas
-    zi = jnp.zeros(s, dtype=jnp.int32)
+
+    def zi():
+        # distinct buffers per field: donation (replica_step
+        # donate_argnums) rejects the same buffer appearing twice
+        return jnp.zeros(s, dtype=jnp.int32)
+
     return ReplicaState(
         ballot=jnp.full(s, NO_BALLOT, dtype=jnp.int32),
-        status=zi,
-        op=zi,
-        key_hi=zi,
-        key_lo=zi,
-        val_hi=zi,
-        val_lo=zi,
-        cmd_id=zi,
-        client_id=zi,
+        status=zi(),
+        op=zi(),
+        key_hi=zi(),
+        key_lo=zi(),
+        val_hi=zi(),
+        val_lo=zi(),
+        cmd_id=zi(),
+        client_id=zi(),
         votes=jnp.zeros((s, r), dtype=bool),
         me=jnp.int32(me),
         window_base=jnp.int32(0),
@@ -220,7 +225,10 @@ def become_leader(cfg: MinPaxosConfig, state: ReplicaState) -> tuple[ReplicaStat
     state = state._replace(
         default_ballot=new_ballot,
         max_recv_ballot=jnp.maximum(state.max_recv_ballot, new_ballot),
-        leader_id=state.me,
+        # .copy(): leader_id must not alias the me buffer — the runtime
+        # donates the state to the jitted step, which rejects one
+        # buffer appearing twice
+        leader_id=state.me.copy(),
         prepared=jnp.asarray(False),
         prepare_oks=jnp.zeros(cfg.n_replicas, dtype=bool).at[state.me].set(True),
     )

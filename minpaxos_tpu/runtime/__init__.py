@@ -1,0 +1,21 @@
+"""Distributed host runtime: real processes over real TCP.
+
+The deployment shape of the reference (SURVEY.md sections 2.1, 3.1):
+one OS process per replica, a master process for registration /
+liveness / leader election, benchmark clients speaking the framed wire
+protocol straight to replicas. The difference is what sits inside the
+replica process: instead of a goroutine per message, a single
+protocol thread drains sockets into fixed-shape columnar batches and
+advances the whole log with one jitted ``replica_step`` per tick
+(models/minpaxos.py) — the same kernel the pod-mode cluster and the
+sharded mesh composition use.
+
+Modules:
+
+* batches   — frame rows <-> device MsgBatch columns
+* stable    — append-only durable log + replay (checkpoint/resume)
+* transport — peer mesh, client listener, handshake, reconnect
+* replica   — the replica server process (event loop)
+* master    — registration/ping/election service
+* client    — benchmark client library (failover, -check)
+"""

@@ -1,0 +1,202 @@
+"""Host-side packing: wire frames <-> device MsgBatch columns.
+
+The device consumes ``MsgBatch`` — 12 parallel i32 columns, one row per
+log slot touched (models/minpaxos.py). The wire carries structured
+frames (wire/messages.py). This module is the boundary: decoded frames
+append into a column buffer that becomes the next step's inbox; outbox
+rows flatten back into frames per destination.
+
+Counterpart of the reference's per-message Marshal/Unmarshal +
+channel-dispatch plumbing (genericsmr.go:402-446 and the *marsh.go
+files); here a 5000-row Accept frame becomes 5000 device rows with a
+handful of numpy column copies.
+
+AcceptReply compression: the device acks one row per slot; on the wire
+contiguous (inst, ballot, ok) runs collapse into a single row with a
+``count`` (like the reference's batched AcceptReply covering a whole
+Accept batch, minpaxosproto.go:75-80) and re-expand on receive.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from minpaxos_tpu.ops.packed import join_i64, split_i64
+from minpaxos_tpu.wire.messages import MsgKind, empty_batch, make_batch
+
+COLS = ("kind", "src", "ballot", "inst", "last_committed", "op",
+        "key_hi", "key_lo", "val_hi", "val_lo", "cmd_id", "client_id")
+
+
+class ColumnBuffer:
+    """Grows rows of MsgBatch columns; drained once per protocol tick."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.cols = {c: np.zeros(capacity, np.int32) for c in COLS}
+        self.fill = 0
+        self.dropped = 0
+
+    def room(self) -> int:
+        return self.capacity - self.fill
+
+    def append(self, n: int, **cols) -> None:
+        """Append n rows; unspecified columns stay zero. Overflow rows
+        are dropped (legal: Paxos tolerates loss; peers retry)."""
+        n_take = min(n, self.room())
+        self.dropped += n - n_take
+        if n_take <= 0:
+            return
+        sl = slice(self.fill, self.fill + n_take)
+        for name, v in cols.items():
+            a = np.asarray(v)
+            self.cols[name][sl] = a[:n_take] if a.ndim else a
+        self.fill += n_take
+
+    def drain(self) -> tuple[dict, int]:
+        """Return (columns, n_rows) and reset. Columns are the full
+        capacity-size arrays (zero-padded past n_rows) so the device
+        sees a fixed shape — no recompiles."""
+        out, n = self.cols, self.fill
+        self.cols = {c: np.zeros(self.capacity, np.int32) for c in COLS}
+        self.fill = 0
+        return out, n
+
+
+def frame_to_rows(buf: ColumnBuffer, kind: MsgKind, rows: np.ndarray,
+                  conn_id: int) -> None:
+    """Append one decoded frame's rows into the inbox column buffer.
+
+    ``conn_id``: for client frames, the server-assigned connection id
+    (becomes client_id); for peer frames, unused (frames carry ids).
+    """
+    n = len(rows)
+    if n == 0:
+        return
+    k = int(kind)
+    if kind == MsgKind.PROPOSE:
+        k_hi, k_lo = split_i64(rows["key"])
+        v_hi, v_lo = split_i64(rows["val"])
+        buf.append(n, kind=k, src=-1, op=rows["op"].astype(np.int32),
+                   key_hi=k_hi, key_lo=k_lo, val_hi=v_hi, val_lo=v_lo,
+                   cmd_id=rows["cmd_id"], client_id=conn_id)
+    elif kind in (MsgKind.ACCEPT, MsgKind.COMMIT):
+        k_hi, k_lo = split_i64(rows["key"])
+        v_hi, v_lo = split_i64(rows["val"])
+        buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
+                   ballot=rows["ballot"], inst=rows["inst"],
+                   last_committed=(rows["last_committed"]
+                                   if kind == MsgKind.ACCEPT else 0),
+                   op=rows["op"].astype(np.int32),
+                   key_hi=k_hi, key_lo=k_lo, val_hi=v_hi, val_lo=v_lo,
+                   cmd_id=rows["cmd_id"], client_id=rows["client_id"])
+    elif kind == MsgKind.ACCEPT_REPLY:
+        # expand (inst, count) runs back into per-slot rows
+        counts = np.maximum(rows["count"], 1)
+        total = int(counts.sum())
+        rep = np.repeat(np.arange(n), counts)
+        offs = np.arange(total) - np.repeat(
+            np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+        buf.append(total, kind=k, src=rows["id"].astype(np.int32)[rep],
+                   ballot=rows["ballot"][rep],
+                   inst=rows["inst"][rep] + offs.astype(np.int32),
+                   last_committed=rows["last_committed"][rep],
+                   op=rows["ok"].astype(np.int32)[rep])
+    elif kind == MsgKind.PREPARE:
+        buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
+                   ballot=rows["ballot"],
+                   last_committed=rows["last_committed"])
+    elif kind == MsgKind.PREPARE_REPLY:
+        buf.append(n, kind=k, src=rows["id"].astype(np.int32),
+                   ballot=rows["ballot"], inst=rows["crt_instance"],
+                   last_committed=rows["last_committed"],
+                   op=rows["ok"].astype(np.int32))
+    elif kind == MsgKind.PREPARE_INST_REPLY:
+        # device convention (models/minpaxos.py step 1b/1c): row ballot
+        # = the slot's accepted vballot; last_committed = the prepare
+        # ballot this reply answers (context tag)
+        k_hi, k_lo = split_i64(rows["key"])
+        v_hi, v_lo = split_i64(rows["val"])
+        buf.append(n, kind=k, src=rows["id"].astype(np.int32),
+                   ballot=rows["vballot"], inst=rows["inst"],
+                   last_committed=rows["ballot"],
+                   op=rows["op"].astype(np.int32),
+                   key_hi=k_hi, key_lo=k_lo, val_hi=v_hi, val_lo=v_lo,
+                   cmd_id=rows["cmd_id"], client_id=rows["client_id"])
+    elif kind == MsgKind.COMMIT_SHORT:
+        # frontier broadcast: inst carries committed_upto (count==0)
+        buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
+                   ballot=rows["ballot"], last_committed=rows["inst"])
+    # READ / BEACON / handshake kinds are handled on the host path
+    # (transport/replica), never as device rows.
+
+
+def _runs(inst: np.ndarray, ballot: np.ndarray, ok: np.ndarray):
+    """Split per-slot ack rows into maximal contiguous runs."""
+    n = len(inst)
+    if n == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    brk = np.nonzero(
+        (inst[1:] != inst[:-1] + 1) | (ballot[1:] != ballot[:-1])
+        | (ok[1:] != ok[:-1]))[0] + 1
+    starts = np.concatenate([[0], brk])
+    ends = np.concatenate([brk, [n]])
+    return starts, ends
+
+
+def rows_to_frames(cols: dict, mask: np.ndarray) -> list[tuple[MsgKind, np.ndarray]]:
+    """Convert masked outbox rows (one destination's worth) into wire
+    frames, one frame per message kind present."""
+    out: list[tuple[MsgKind, np.ndarray]] = []
+    kinds = cols["kind"][mask]
+    if len(kinds) == 0:
+        return out
+    sub = {c: cols[c][mask] for c in COLS}
+    for k in np.unique(kinds):
+        m = kinds == k
+        kind = MsgKind(int(k))
+        if kind in (MsgKind.ACCEPT, MsgKind.COMMIT):
+            frame = make_batch(
+                kind, leader_id=sub["src"][m], inst=sub["inst"][m],
+                ballot=sub["ballot"][m],
+                op=sub["op"][m], key=join_i64(sub["key_hi"][m], sub["key_lo"][m]),
+                val=join_i64(sub["val_hi"][m], sub["val_lo"][m]),
+                cmd_id=sub["cmd_id"][m], client_id=sub["client_id"][m],
+                **({"last_committed": sub["last_committed"][m]}
+                   if kind == MsgKind.ACCEPT else {}))
+        elif kind == MsgKind.ACCEPT_REPLY:
+            inst, ball, ok = sub["inst"][m], sub["ballot"][m], sub["op"][m]
+            lc, src = sub["last_committed"][m], sub["src"][m]
+            order = np.argsort(inst, kind="stable")
+            inst, ball, ok = inst[order], ball[order], ok[order]
+            lc, src = lc[order], src[order]
+            starts, ends = _runs(inst, ball, ok)
+            frame = make_batch(
+                kind, id=src[starts], ok=ok[starts], inst=inst[starts],
+                count=(ends - starts).astype(np.int32), ballot=ball[starts],
+                last_committed=lc[starts])
+        elif kind == MsgKind.PREPARE:
+            frame = make_batch(kind, leader_id=sub["src"][m],
+                               ballot=sub["ballot"][m],
+                               last_committed=sub["last_committed"][m])
+        elif kind == MsgKind.PREPARE_REPLY:
+            frame = make_batch(kind, id=sub["src"][m], ok=sub["op"][m],
+                               ballot=sub["ballot"][m],
+                               last_committed=sub["last_committed"][m],
+                               crt_instance=sub["inst"][m])
+        elif kind == MsgKind.PREPARE_INST_REPLY:
+            frame = make_batch(
+                kind, id=sub["src"][m], ok=1, inst=sub["inst"][m],
+                ballot=sub["last_committed"][m], vballot=sub["ballot"][m],
+                op=sub["op"][m],
+                key=join_i64(sub["key_hi"][m], sub["key_lo"][m]),
+                val=join_i64(sub["val_hi"][m], sub["val_lo"][m]),
+                cmd_id=sub["cmd_id"][m], client_id=sub["client_id"][m])
+        elif kind == MsgKind.COMMIT_SHORT:
+            frame = make_batch(kind, leader_id=sub["src"][m],
+                               inst=sub["last_committed"][m], count=0,
+                               ballot=sub["ballot"][m])
+        else:
+            continue  # PROPOSE_REPLY etc. are built by the reply path
+        out.append((kind, frame))
+    return out

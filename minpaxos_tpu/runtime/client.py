@@ -1,0 +1,221 @@
+"""Benchmark client library: leader discovery, batched proposes,
+failover retry, exactly-once checking.
+
+Counterpart of the reference's client family (SURVEY.md section 2.4):
+``client`` (closed-loop rounds, conflict-% / Zipfian keys, -check),
+``clientretry`` (outer retry loop that re-dials and adopts any
+reachable replica when the leader dies, clientretry.go:120-150), and
+the latency/throughput probes (clientlat, clienttot, client-ol-lat)
+whose measurement styles the CLI reproduces.
+
+Retry semantics: unacknowledged commands are re-sent with the SAME
+cmd_id after failover, and replies are deduplicated by cmd_id — an
+explicit upgrade over the reference, which restarts CommandIds from 0
+on retry and can observe duplicates (clientretry.go:152, SURVEY.md
+section 7.4).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+
+from minpaxos_tpu.runtime.master import get_leader, get_replica_list
+from minpaxos_tpu.utils.dlog import dlog
+from minpaxos_tpu.wire.codec import FrameWriter, StreamDecoder
+from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
+
+
+def gen_workload(n: int, conflict_pct: int = 0, key_range: int = 100000,
+                 zipf_s: float = 0.0, write_pct: int = 100,
+                 seed: int = 42) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pre-generated request arrays (ops, keys, vals) — the reference
+    pre-builds karray/put with conflict-% or Zipfian keys
+    (client.go:68-103; seed 42 at :45)."""
+    rng = np.random.default_rng(seed)
+    if zipf_s > 0:
+        keys = (rng.zipf(zipf_s, n) - 1) % key_range
+    else:
+        keys = rng.integers(0, key_range, n)
+        conflicted = rng.integers(0, 100, n) < conflict_pct
+        keys = np.where(conflicted, 42, keys)  # all conflicts hit one key
+    ops = np.where(rng.integers(0, 100, n) < write_pct,
+                   int(Op.PUT), int(Op.GET))
+    vals = rng.integers(1, 1 << 20, n)
+    return ops.astype(np.int64), keys.astype(np.int64), vals.astype(np.int64)
+
+
+class Client:
+    """One TCP connection to one replica + reply collection thread."""
+
+    def __init__(self, maddr: tuple[str, int], check: bool = False):
+        self.maddr = maddr
+        self.check = check
+        self.nodes = get_replica_list(maddr)
+        self.leader = get_leader(maddr)
+        self.sock: socket.socket | None = None
+        self.writer: FrameWriter | None = None
+        self.replies: dict[int, dict] = {}  # cmd_id -> reply
+        self.dup_replies = 0
+        self.rejected: list[int] = []
+        self.leader_hint = -1
+        self._lock = threading.Lock()
+        self._got = threading.Condition(self._lock)
+        self._reader: threading.Thread | None = None
+        self._closed = threading.Event()
+
+    # -- connection management --
+
+    def connect(self, replica: int | None = None) -> None:
+        self.close_conn()
+        self._closed.clear()
+        rid = self.leader if replica is None else replica
+        host, port = self.nodes[rid]
+        self.sock = socket.create_connection((host, port), timeout=5.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.sendall(bytes([int(MsgKind.HANDSHAKE_CLIENT)]))
+        self.writer = FrameWriter(self.sock)
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        self.connected_to = rid
+
+    def close_conn(self) -> None:
+        self._closed.set()
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def _read_loop(self) -> None:
+        dec = StreamDecoder()
+        sock = self.sock
+        while not self._closed.is_set():
+            try:
+                chunk = sock.recv(1 << 16)
+            except OSError:
+                break
+            if not chunk:
+                break
+            for kind, rows in dec.feed(chunk):
+                self._on_frame(kind, rows)
+            if dec.error is not None:
+                break
+        with self._got:
+            self._got.notify_all()
+
+    def _on_frame(self, kind: MsgKind, rows: np.ndarray) -> None:
+        if kind not in (MsgKind.PROPOSE_REPLY, MsgKind.READ_REPLY):
+            return
+        with self._got:
+            for r in rows:
+                cmd = int(r["cmd_id"])
+                if kind == MsgKind.PROPOSE_REPLY and not r["ok"]:
+                    self.leader_hint = int(r["leader"])
+                    self.rejected.append(cmd)
+                    continue
+                if cmd in self.replies:
+                    self.dup_replies += 1  # -check duplicate detection
+                    continue
+                entry = {"val": int(r["val"])}
+                if kind == MsgKind.PROPOSE_REPLY:
+                    entry["ts"] = int(r["timestamp"])
+                self.replies[cmd] = entry
+            self._got.notify_all()
+
+    # -- propose / wait --
+
+    def propose(self, cmd_ids, ops, keys, vals) -> None:
+        frame = make_batch(MsgKind.PROPOSE, cmd_id=np.asarray(cmd_ids, np.int32),
+                           op=np.asarray(ops), key=np.asarray(keys),
+                           val=np.asarray(vals),
+                           timestamp=time.monotonic_ns())
+        self.writer.write(MsgKind.PROPOSE, frame)
+        self.writer.flush()
+
+    def read(self, cmd_ids, keys) -> None:
+        frame = make_batch(MsgKind.READ, cmd_id=np.asarray(cmd_ids, np.int32),
+                           key=np.asarray(keys))
+        self.writer.write(MsgKind.READ, frame)
+        self.writer.flush()
+
+    def wait(self, cmd_ids, timeout_s: float = 10.0) -> bool:
+        """Block until every cmd_id has a success reply (or timeout)."""
+        deadline = time.monotonic() + timeout_s
+        want = set(int(c) for c in cmd_ids)
+        with self._got:
+            while True:
+                missing = want - self.replies.keys()
+                if not missing:
+                    return True
+                left = deadline - time.monotonic()
+                if left <= 0 or self._closed.is_set():
+                    return not missing
+                self._got.wait(timeout=min(left, 0.25))
+
+    # -- the retry driver (clientretry.go:120-150 semantics) --
+
+    def run_workload(self, ops, keys, vals, batch: int = 512,
+                     timeout_s: float = 60.0) -> dict:
+        """Send everything, retrying unacked commands across failovers
+        with the same cmd_ids. Returns stats incl. -check results."""
+        n = len(ops)
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        if self.sock is None:
+            self.connect()
+        cursor = 0
+        while time.monotonic() < deadline:
+            with self._lock:
+                done = len(self.replies)
+            if done >= n:
+                break
+            if cursor >= n:
+                cursor = 0  # sweep again for commands lost to failover
+            # (re)send the next window of unacked commands
+            unacked = [c for c in range(cursor, min(cursor + batch, n))
+                       if c not in self.replies]
+            cursor += batch
+            if not unacked:
+                continue
+            idx = np.asarray(unacked)
+            try:
+                self.propose(idx, ops[idx], keys[idx], vals[idx])
+                ok = self.wait(idx, timeout_s=3.0)
+            except OSError:
+                ok = False
+            if not ok:
+                self._failover()
+        wall = time.monotonic() - t0
+        with self._lock:
+            done = len(self.replies)
+        return {"sent": n, "acked": done, "wall_s": wall,
+                "ops_per_s": done / wall if wall > 0 else 0.0,
+                "duplicates": self.dup_replies,
+                "missing": n - done}
+
+    def _failover(self) -> None:
+        """Leader died or rejected us: prefer its hint, else ask the
+        master, else scan replicas for any that accepts TCP
+        (clientretry.go:242-251)."""
+        candidates: list[int] = []
+        if 0 <= self.leader_hint < len(self.nodes):
+            candidates.append(self.leader_hint)
+        try:
+            candidates.append(get_leader(self.maddr, timeout_s=3.0))
+        except TimeoutError:
+            pass
+        candidates.extend(r for r in range(len(self.nodes)))
+        for rid in candidates:
+            try:
+                self.connect(rid)
+                self.leader = rid
+                dlog(f"client: failed over to replica {rid}")
+                return
+            except OSError:
+                continue
+        time.sleep(0.5)

@@ -1,0 +1,222 @@
+"""Cluster master: registration, liveness pings, leader election.
+
+Counterpart of reference src/master/master.go: collect N registrations
+(master.go:114-152), declare an initial leader (:79), ping every
+replica on a 3s loop (:81-97), and on leader death promote a live
+replica via its BeTheLeader control RPC (:101-110). Clients ask it
+GetLeader / GetReplicaList (:154-176).
+
+Differences, both deliberate:
+* JSON-lines over TCP instead of Go net/rpc-over-HTTP — same control
+  semantics, no data-path involvement.
+* Election picks the alive replica with the HIGHEST committed frontier
+  (the pings carry it), not merely the first alive one — a laggard
+  leader beyond the others' retained windows would wedge the cluster
+  (models/minpaxos.py window-slide LIMIT note); the reference's
+  first-alive choice has the same hazard and simply never hits it at
+  its scale.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+from minpaxos_tpu.utils.dlog import dlog
+
+
+def _rpc(addr: tuple[str, int], req: dict, timeout: float = 2.0) -> dict:
+    with socket.create_connection(addr, timeout=timeout) as s:
+        f = s.makefile("rw")
+        f.write(json.dumps(req) + "\n")
+        f.flush()
+        line = f.readline()
+    if not line:
+        raise OSError("empty rpc reply")
+    return json.loads(line)
+
+
+class Master:
+    def __init__(self, host: str, port: int, n_replicas: int,
+                 ping_s: float = 1.0):
+        self.addr = (host, port)
+        self.n = n_replicas
+        self.ping_s = ping_s
+        self.nodes: list[tuple[str, int]] = []  # data-port addrs by id
+        self.alive: list[bool] = []
+        self.frontiers: list[int] = []
+        self.leader = -1
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock: socket.socket | None = None
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(self.addr)
+        s.listen(64)
+        self._sock = s
+        threading.Thread(target=self._serve, daemon=True).start()
+        threading.Thread(target=self._ping_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- RPC service --
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn, args=(conn,),
+                             daemon=True).start()
+
+    def _conn(self, conn) -> None:
+        f = conn.makefile("rw")
+        try:
+            for line in f:
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                f.write(json.dumps(self._handle(req)) + "\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, req: dict) -> dict:
+        m = req.get("m")
+        with self._lock:
+            if m == "register":
+                addr = (req["addr"], int(req["port"]))
+                if addr in self.nodes:
+                    rid = self.nodes.index(addr)
+                else:
+                    if len(self.nodes) >= self.n:
+                        return {"ok": False, "error": "cluster full"}
+                    self.nodes.append(addr)
+                    self.alive.append(True)
+                    self.frontiers.append(-1)
+                    rid = len(self.nodes) - 1
+                    if len(self.nodes) == self.n and self.leader < 0:
+                        self.leader = 0  # initial leader (master.go:79)
+                return {"ok": True, "id": rid, "n": self.n,
+                        "ready": len(self.nodes) == self.n}
+            if m == "get_replica_list":
+                # reference blocks until all registered (master.go:165)
+                return {"ok": len(self.nodes) == self.n,
+                        "nodes": [list(a) for a in self.nodes]}
+            if m == "get_leader":
+                if self.leader < 0:
+                    return {"ok": False}
+                host, port = self.nodes[self.leader]
+                return {"ok": True, "leader": self.leader,
+                        "addr": host, "port": port}
+            return {"ok": False, "error": f"unknown method {m}"}
+
+    # -- liveness + election (master.go:81-111) --
+
+    def _ping_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self.ping_s)
+            with self._lock:
+                nodes = list(enumerate(self.nodes))
+                leader = self.leader
+            if not nodes:
+                continue
+            for rid, (host, port) in nodes:
+                try:
+                    resp = _rpc((host, port + 1000), {"m": "ping"},
+                                timeout=1.0)
+                    ok = bool(resp.get("ok"))
+                    fr = int(resp.get("frontier", -1))
+                except (OSError, json.JSONDecodeError):
+                    ok, fr = False, -1
+                with self._lock:
+                    self.alive[rid] = ok
+                    if ok:
+                        self.frontiers[rid] = fr
+            with self._lock:
+                leader_dead = (0 <= leader < len(self.alive)
+                               and not self.alive[leader])
+                if leader_dead:
+                    cand = [(self.frontiers[r], -r) for r in range(len(self.nodes))
+                            if self.alive[r]]
+                    if not cand:
+                        continue
+                    _, neg = max(cand)
+                    new_leader = -neg
+                    self.leader = new_leader
+                    host, port = self.nodes[new_leader]
+                else:
+                    continue
+            dlog(f"master: leader {leader} dead -> promoting {new_leader}")
+            try:
+                _rpc((host, port + 1000), {"m": "be_the_leader"}, timeout=2.0)
+            except (OSError, json.JSONDecodeError):
+                pass
+
+
+def register_with_master(maddr: tuple[str, int], my_host: str, my_port: int,
+                         retry_s: float = 0.5, timeout_s: float = 60.0) -> int:
+    """Server-side registration retry loop (server.go:91-108). Returns
+    the assigned replica id once the full membership is known."""
+    deadline = time.monotonic() + timeout_s
+    rid = None
+    while time.monotonic() < deadline:
+        try:
+            resp = _rpc(maddr, {"m": "register",
+                                "addr": my_host, "port": my_port})
+            if resp.get("ok"):
+                rid = int(resp["id"])
+                if resp.get("ready"):
+                    return rid
+        except (OSError, json.JSONDecodeError):
+            pass
+        time.sleep(retry_s)
+    if rid is not None:
+        return rid
+    raise TimeoutError("could not register with master")
+
+
+def get_replica_list(maddr: tuple[str, int],
+                     timeout_s: float = 60.0) -> list[tuple[str, int]]:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            resp = _rpc(maddr, {"m": "get_replica_list"})
+            if resp.get("ok"):
+                return [tuple(a) for a in resp["nodes"]]
+        except (OSError, json.JSONDecodeError):
+            pass
+        time.sleep(0.3)
+    raise TimeoutError("replica list never completed")
+
+
+def get_leader(maddr: tuple[str, int], timeout_s: float = 60.0) -> int:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            resp = _rpc(maddr, {"m": "get_leader"})
+            if resp.get("ok"):
+                return int(resp["leader"])
+        except (OSError, json.JSONDecodeError):
+            pass
+        time.sleep(0.3)
+    raise TimeoutError("no leader known")

@@ -1,0 +1,519 @@
+"""The replica server process: one protocol thread, one jitted step.
+
+Counterpart of the reference's server binary + genericsmr runtime +
+bareminpaxos event loop (server.go:36-117, genericsmr.go:70-111,
+bareminpaxos.go:247-381), restructured TPU-first: instead of a
+goroutine per connection feeding per-message channels into a
+select loop, reader threads enqueue decoded frames; the protocol
+thread drains them into a fixed-shape column batch once per tick and
+advances the WHOLE replica with one ``replica_step`` call; the outbox
+scatters back to peer/client sockets. Durability, beacons, READ
+serving, beyond-window catch-up, and control RPCs ride the host path
+around the device step (SURVEY.md section 7.4: ragged/cold paths stay
+off the device).
+
+Single-owner: protocol state, writers, and the stable store are
+touched only by the protocol thread — the reference's benign races
+(SURVEY.md section 5) are structurally impossible.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import queue
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from minpaxos_tpu.models.minpaxos import (
+    ACCEPTED,
+    COMMITTED,
+    MinPaxosConfig,
+    MsgBatch,
+    become_leader,
+    init_replica,
+    replica_step_impl,
+)
+from minpaxos_tpu.ops.packed import join_i64, split_i64
+from minpaxos_tpu.runtime import batches
+from minpaxos_tpu.runtime.stable import SLOT_DT, StableStore
+from minpaxos_tpu.runtime.transport import (
+    CONN_LOST,
+    FROM_CLIENT,
+    FROM_PEER,
+    Transport,
+)
+from minpaxos_tpu.utils.clock import cputicks, monotonic_ns
+from minpaxos_tpu.utils.dlog import dlog
+from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
+
+CONTROL = 3  # queue item source tag (transport uses 0..2)
+
+
+@dataclass
+class RuntimeFlags:
+    """Server knobs — the reference's flag set (server.go:19-34)."""
+
+    exec_: bool = True     # -exec: apply committed commands
+    dreply: bool = True    # -dreply: reply after execution (with value)
+    durable: bool = False  # -durable: fsync accepted slots per tick
+    thrifty: bool = False  # -thrifty: send accepts to a quorum only
+    beacon: bool = False   # -beacon: RTT beacons -> preferred quorum
+    tick_s: float = 0.002  # protocol tick (reference clock: 5ms)
+    store_dir: str = "."
+
+
+class ReplicaServer:
+    def __init__(self, me: int, addrs: list[tuple[str, int]],
+                 cfg: MinPaxosConfig | None = None,
+                 flags: RuntimeFlags | None = None):
+        self.me = me
+        self.addrs = addrs
+        self.cfg = cfg or MinPaxosConfig(
+            n_replicas=len(addrs), window=1 << 14, inbox=4096,
+            exec_batch=4096, kv_pow2=16, catchup_rows=256,
+            recovery_rows=256)
+        assert self.cfg.n_replicas == len(addrs)
+        self.flags = flags or RuntimeFlags()
+        self.transport = Transport(me, addrs)
+        self.queue = self.transport.queue
+        self.step = jax.jit(
+            functools.partial(replica_step_impl, self.cfg),
+            donate_argnums=0)
+        # copy every leaf: jax caches/aliases equal small constants, and
+        # donation rejects the same buffer appearing twice
+        self.state = jax.tree_util.tree_map(
+            lambda x: x.copy(), init_replica(self.cfg, me))
+        self.store = StableStore(
+            f"{self.flags.store_dir}/stable-store-replica{me}",
+            sync=self.flags.durable)
+        self.inbox = batches.ColumnBuffer(self.cfg.inbox)
+        # reply bookkeeping: (conn_id, cmd_id) -> reply kind to send
+        self._pending: dict[tuple[int, int], MsgKind] = {}
+        self._replied: set[tuple[int, int]] = set()
+        self.rtt_ewma = np.full(len(addrs), np.inf)
+        self._stop = threading.Event()
+        self._recovered = self.store.recovered
+        self.stats = {"ticks": 0, "committed": 0, "executed": 0,
+                      "proposals": 0}
+        self._ctl_sock: socket.socket | None = None
+
+    # ---------------- lifecycle ----------------
+
+    def start(self) -> None:
+        self.transport.listen()
+        self._start_control()
+        if self._recovered:
+            self._recover_from_store()
+        self.transport.connect_peers()
+        threading.Thread(target=self._run, daemon=True).start()
+        if self.flags.beacon:
+            threading.Thread(target=self._beacon_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.transport.stop()
+        if self._ctl_sock is not None:
+            try:
+                self._ctl_sock.close()
+            except OSError:
+                pass
+        self.store.close()
+
+    # ---------------- recovery (stable-store replay) ----------------
+
+    def _recover_from_store(self) -> None:
+        """Rebuild device state by replaying the durable log through
+        the SAME protocol kernel: committed prefix as COMMIT rows
+        (commits + executes + rebuilds the KV + slides the window),
+        accepted tail as ACCEPT rows. The reference's
+        getDataFromStableStore (bareminpaxos.go:122-161) rebuilt Go
+        structs; here recovery IS the protocol."""
+        frontier = self.store.committed_prefix()
+        max_ballot = max((int(r["ballot"]) for r in self.store.slots.values()),
+                         default=0)
+        chunk = self.cfg.exec_batch
+        for lo in range(0, frontier + 1, chunk):
+            rec = self.store.read_range(lo, min(lo + chunk, frontier + 1) - 1)
+            self._feed_records(rec, MsgKind.COMMIT)
+        tail = self.store.read_range(frontier + 1, self.store.max_inst())
+        if len(tail):
+            self._feed_records(tail, MsgKind.ACCEPT)
+        # restore the ballot promise (ballot low 4 bits = proposer id,
+        # bareminpaxos.go:383-385)
+        if max_ballot > 0:
+            buf = batches.ColumnBuffer(self.cfg.inbox)
+            buf.append(1, kind=int(MsgKind.PREPARE), src=max_ballot % 16,
+                       ballot=max_ballot,
+                       last_committed=int(np.asarray(self.state.committed_upto)))
+            self._device_tick(buf)
+        dlog(f"replica {self.me}: recovered frontier={frontier} "
+             f"tail={len(tail)} ballot={max_ballot}")
+
+    def _feed_records(self, rec: np.ndarray, kind: MsgKind) -> None:
+        if len(rec) == 0:
+            return
+        k_hi, k_lo = split_i64(rec["key"])
+        v_hi, v_lo = split_i64(rec["val"])
+        for lo in range(0, len(rec), self.cfg.inbox):
+            sl = slice(lo, lo + self.cfg.inbox)
+            buf = batches.ColumnBuffer(self.cfg.inbox)
+            buf.append(len(rec[sl]), kind=int(kind),
+                       src=rec["ballot"][sl] % 16, ballot=rec["ballot"][sl],
+                       inst=rec["inst"][sl],
+                       last_committed=self.store.frontier
+                       if kind == MsgKind.ACCEPT else 0,
+                       op=rec["op"][sl].astype(np.int32),
+                       key_hi=k_hi[sl], key_lo=k_lo[sl],
+                       val_hi=v_hi[sl], val_lo=v_lo[sl],
+                       cmd_id=rec["cmd_id"][sl],
+                       client_id=rec["client_id"][sl])
+            self._device_tick(buf, persist=False, dispatch=False)
+
+    # ---------------- control plane (port + 1000) ----------------
+
+    def _start_control(self) -> None:
+        host, port = self.addrs[self.me]
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port + 1000))
+        s.listen(16)
+        self._ctl_sock = s
+        threading.Thread(target=self._control_loop, daemon=True).start()
+
+    def _control_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._ctl_sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._control_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _control_conn(self, conn) -> None:
+        f = conn.makefile("rw")
+        try:
+            for line in f:
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    break
+                m = req.get("m")
+                if m == "ping":
+                    resp = {"ok": True,
+                            "frontier": int(np.asarray(self.state.committed_upto)),
+                            "leader": int(np.asarray(self.state.leader_id)),
+                            "stats": self.stats}
+                elif m == "be_the_leader":
+                    self.queue.put((CONTROL, 0, "be_the_leader", None))
+                    resp = {"ok": True}
+                else:
+                    resp = {"ok": False, "error": f"unknown method {m}"}
+                f.write(json.dumps(resp) + "\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # ---------------- beacons ----------------
+
+    def _beacon_loop(self) -> None:
+        """Reference SendBeacon/ReplyBeacon + EWMA RTT
+        (genericsmr.go:537-551, :429)."""
+        while not self._stop.is_set():
+            rows = make_batch(MsgKind.BEACON, rid=self.me,
+                              timestamp=np.uint64(cputicks()))
+            for q in range(self.cfg.n_replicas):
+                if q != self.me:
+                    self.transport.send_peer(q, MsgKind.BEACON, rows)
+            time.sleep(0.2)
+
+    # ---------------- the protocol loop ----------------
+
+    def _run(self) -> None:
+        if not self._recovered and self.me == 0:
+            # initial boot: replica 0 self-elects
+            # (bareminpaxos.go:286-290); wait until the mesh is up so
+            # the PREPARE reaches everyone
+            self._wait_for_peers()
+            self.queue.put((CONTROL, 0, "be_the_leader", None))
+        while not self._stop.is_set():
+            self._tick()
+
+    def _wait_for_peers(self, timeout_s: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        need = self.cfg.n_replicas - 1
+        while time.monotonic() < deadline:
+            n = sum(self.transport.peer_alive(q)
+                    for q in range(self.cfg.n_replicas) if q != self.me)
+            if n >= need:
+                return
+            for q in range(self.me):
+                if not self.transport.peer_alive(q):
+                    self.transport.dial_peer(q)
+            time.sleep(0.05)
+
+    def _tick(self) -> None:
+        elect = self._drain(self.flags.tick_s)
+        if elect:
+            self._become_leader()
+        self._device_tick(self.inbox)
+        self.stats["ticks"] += 1
+
+    def _drain(self, timeout_s: float) -> bool:
+        """Pull queued frames into the inbox buffer; returns whether a
+        be_the_leader control event arrived."""
+        elect = False
+        try:
+            item = self.queue.get(timeout=timeout_s)
+        except queue.Empty:
+            return False
+        while True:
+            src_kind, conn_id, kind, rows = item
+            if src_kind == CONTROL:
+                if kind == "be_the_leader":
+                    elect = True
+            elif src_kind == CONN_LOST:
+                pass  # peer redial is lazy (dispatch path)
+            elif kind == MsgKind.BEACON:
+                self.transport.send_peer(
+                    int(rows["rid"][0]), MsgKind.BEACON_REPLY, rows)
+            elif kind == MsgKind.BEACON_REPLY:
+                rtt = cputicks() - int(rows["timestamp"][0])
+                q = int(rows["rid"][0])
+                if q != self.me:
+                    old = self.rtt_ewma[q]
+                    self.rtt_ewma[q] = (rtt if np.isinf(old)
+                                        else 0.99 * old + 0.01 * rtt)
+            elif kind == MsgKind.READ:
+                # linearizable read: goes through the log as a GET
+                # (the reference parses-and-drops READ,
+                # genericsmr.go:470-477; we serve it)
+                n = len(rows)
+                k_hi, k_lo = split_i64(rows["key"])
+                self.inbox.append(
+                    n, kind=int(MsgKind.PROPOSE), src=-1, op=int(Op.GET),
+                    key_hi=k_hi, key_lo=k_lo, cmd_id=rows["cmd_id"],
+                    client_id=conn_id)
+                for c in rows["cmd_id"]:
+                    self._pending[(conn_id, int(c))] = MsgKind.READ_REPLY
+            else:
+                if src_kind == FROM_CLIENT and kind == MsgKind.PROPOSE:
+                    for c in rows["cmd_id"]:
+                        self._pending[(conn_id, int(c))] = MsgKind.PROPOSE_REPLY
+                    self.stats["proposals"] += len(rows)
+                batches.frame_to_rows(self.inbox, kind, rows, conn_id)
+            if self.inbox.room() <= 0:
+                break
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+        return elect
+
+    def _become_leader(self) -> None:
+        self.state, prep = become_leader(self.cfg, self.state)
+        cols = {c: np.asarray(getattr(prep, c)) for c in batches.COLS
+                if c != "kind"}
+        cols["kind"] = np.asarray(prep.kind)
+        frames = batches.rows_to_frames(cols, np.array([True]))
+        for kind, frame in frames:
+            for q in range(self.cfg.n_replicas):
+                if q != self.me:
+                    self._send_or_redial(q, kind, frame)
+        self.transport.flush_all()
+        dlog(f"replica {self.me}: running election")
+
+    def _device_tick(self, buf: batches.ColumnBuffer,
+                     persist: bool = True, dispatch: bool = True) -> None:
+        cols, n_rows = buf.drain()
+        inbox = MsgBatch(**{c: np.asarray(cols[c]) for c in batches.COLS})
+        self.state, outbox, execr = self.step(self.state, inbox)
+        out_cols = {c: np.asarray(getattr(outbox.msgs, c))
+                    for c in batches.COLS}
+        dst = np.asarray(outbox.dst)
+        if persist:
+            # always maintained (in-memory mirror feeds beyond-window
+            # catch-up); -durable additionally fsyncs before replies
+            self._persist(cols, n_rows, out_cols, dst)
+        if dispatch:
+            self._dispatch(out_cols, dst)
+            self._reply(execr, out_cols, dst)
+            self._host_catchup()
+            self.transport.flush_all()
+
+    # -- durability: reconstruct accepted slots from (inbox, outbox) --
+
+    def _persist(self, in_cols, n_rows, out_cols, dst) -> None:
+        """Outbox row i is derived from inbox row i (models/minpaxos.py
+        Outbox doc), so accepted slots are recoverable host-side:
+
+        * follower acks: out ACCEPT_REPLY ok=1 at i -> slot from inbox i
+        * leader self-accepts: out ACCEPT broadcast at i -> cmd from
+          inbox PROPOSE row i
+        * commits applied: inbox COMMIT rows
+        * retry/noop rows (appended tail segments): out ACCEPT rows
+          beyond the inbox range carry full commands
+        """
+        n = n_rows
+        ik = in_cols["kind"][:n]
+        ok_acc = ((out_cols["kind"][:n] == int(MsgKind.ACCEPT_REPLY))
+                  & (out_cols["op"][:n] == 1) & (ik == int(MsgKind.ACCEPT)))
+        lead_acc = out_cols["kind"][:n] == int(MsgKind.ACCEPT)
+        com = ik == int(MsgKind.COMMIT)
+        recs = []
+        if ok_acc.any() or com.any():
+            m = ok_acc | com
+            recs.append((in_cols["inst"][:n][m], in_cols["ballot"][:n][m],
+                         np.where(com[m], COMMITTED, ACCEPTED),
+                         in_cols["op"][:n][m],
+                         join_i64(in_cols["key_hi"][:n][m], in_cols["key_lo"][:n][m]),
+                         join_i64(in_cols["val_hi"][:n][m], in_cols["val_lo"][:n][m]),
+                         in_cols["cmd_id"][:n][m], in_cols["client_id"][:n][m]))
+        if lead_acc.any():
+            m = lead_acc
+            recs.append((out_cols["inst"][:n][m], out_cols["ballot"][:n][m],
+                         np.full(m.sum(), ACCEPTED),
+                         out_cols["op"][:n][m],
+                         join_i64(out_cols["key_hi"][:n][m], out_cols["key_lo"][:n][m]),
+                         join_i64(out_cols["val_hi"][:n][m], out_cols["val_lo"][:n][m]),
+                         out_cols["cmd_id"][:n][m], out_cols["client_id"][:n][m]))
+        # appended tail segments (recovery/frontier/catchup/retry rows)
+        tk = out_cols["kind"][n if n else 0:]
+        tail_acc = tk == int(MsgKind.ACCEPT)
+        if tail_acc.any():
+            t = slice(n, None)
+            m = tail_acc
+            recs.append((out_cols["inst"][t][m], out_cols["ballot"][t][m],
+                         np.full(m.sum(), ACCEPTED),
+                         out_cols["op"][t][m],
+                         join_i64(out_cols["key_hi"][t][m], out_cols["key_lo"][t][m]),
+                         join_i64(out_cols["val_hi"][t][m], out_cols["val_lo"][t][m]),
+                         out_cols["cmd_id"][t][m], out_cols["client_id"][t][m]))
+        wrote = False
+        for inst, ballot, status, op, key, val, cmd, cli in recs:
+            if len(inst):
+                self.store.append_slots(inst, ballot, status, op, key, val,
+                                        cmd, cli)
+                wrote = True
+        fr = int(np.asarray(self.state.committed_upto))
+        if fr > self.store.frontier:
+            self.store.append_frontier(fr)
+            wrote = True
+        if wrote:
+            self.store.flush()  # fsync BEFORE acks/replies leave
+
+    # -- outbox dispatch --
+
+    def _quorum_targets(self) -> list[int]:
+        """Thrifty: accepts go to floor(N/2) peers only
+        (paxos.go:278-281); with beacons on, the lowest-RTT peers
+        (UpdatePreferredPeerOrder, genericsmr.go:554-580)."""
+        peers = [q for q in range(self.cfg.n_replicas) if q != self.me]
+        if self.flags.beacon:
+            peers.sort(key=lambda q: self.rtt_ewma[q])
+        return peers[: self.cfg.n_replicas // 2]
+
+    def _send_or_redial(self, q, kind, frame) -> None:
+        if not self.transport.send_peer(q, kind, frame):
+            if self.transport.dial_peer(q):
+                self.transport.send_peer(q, kind, frame)
+
+    def _dispatch(self, out_cols, dst) -> None:
+        kinds = out_cols["kind"]
+        live = kinds != 0
+        if not live.any():
+            return
+        thrifty_q = self._quorum_targets() if self.flags.thrifty else None
+        for q in range(self.cfg.n_replicas):
+            if q == self.me:
+                continue
+            mask = live & ((dst == q) | (dst == -1))
+            if thrifty_q is not None and q not in thrifty_q:
+                # thrifty drops broadcast ACCEPTs for non-quorum peers;
+                # unicast rows (their catch-up) still flow
+                mask = mask & ~((dst == -1) & (kinds == int(MsgKind.ACCEPT)))
+            if not mask.any():
+                continue
+            for kind, frame in batches.rows_to_frames(out_cols, mask):
+                self._send_or_redial(q, kind, frame)
+        # client-bound rejections (dst == -2): ProposeReplyTS{FALSE,
+        # Leader} so clients re-route (bareminpaxos.go:618-625)
+        rej = live & (dst == -2) & (kinds == int(MsgKind.PROPOSE_REPLY))
+        if rej.any():
+            leader_hint = out_cols["ballot"][rej]
+            cids = out_cols["client_id"][rej]
+            cmds = out_cols["cmd_id"][rej]
+            for cid in np.unique(cids):
+                m = cids == cid
+                frame = make_batch(MsgKind.PROPOSE_REPLY, ok=0,
+                                   cmd_id=cmds[m], val=0,
+                                   timestamp=monotonic_ns(),
+                                   leader=leader_hint[m].astype(np.int8))
+                self.transport.send_client(int(cid), MsgKind.PROPOSE_REPLY,
+                                           frame)
+                for c in cmds[m]:
+                    self._pending.pop((int(cid), int(c)), None)
+
+    # -- execution replies (ReplyProposeTS, genericsmr.go:529) --
+
+    def _reply(self, execr, out_cols, dst) -> None:
+        n = int(np.asarray(execr.count))
+        self.stats["executed"] += n
+        self.stats["committed"] = int(np.asarray(self.state.committed_upto)) + 1
+        if n == 0 or not self.flags.dreply:
+            return
+        cids = np.asarray(execr.client_id)[:n]
+        cmds = np.asarray(execr.cmd_id)[:n]
+        vals = join_i64(np.asarray(execr.val_hi)[:n],
+                        np.asarray(execr.val_lo)[:n])
+        for i in range(n):
+            key = (int(cids[i]), int(cmds[i]))
+            want = self._pending.pop(key, None)
+            if want is None:
+                continue  # not proposed on this conn (or already replied)
+            if want == MsgKind.READ_REPLY:
+                frame = make_batch(MsgKind.READ_REPLY, cmd_id=key[1],
+                                   val=int(vals[i]))
+            else:
+                frame = make_batch(MsgKind.PROPOSE_REPLY, ok=1,
+                                   cmd_id=key[1], val=int(vals[i]),
+                                   timestamp=monotonic_ns(),
+                                   leader=np.int8(self.me))
+            self.transport.send_client(key[0], want, frame)
+
+    # -- beyond-window catch-up from the durable log --
+
+    def _host_catchup(self) -> None:
+        """A peer lagging behind window_base can't be healed by device
+        catch-up rows (they slid out); serve it from the stable store's
+        in-memory mirror instead — the runtime's replacement for the
+        reference replaying its whole file to the new process."""
+        if not bool(np.asarray(self.state.prepared)):
+            return
+        if int(np.asarray(self.state.leader_id)) != self.me:
+            return
+        base = int(np.asarray(self.state.window_base))
+        pc = np.asarray(self.state.peer_commits)
+        for q in range(self.cfg.n_replicas):
+            if q == self.me or pc[q] + 1 >= base:
+                continue
+            rec = self.store.read_range(int(pc[q]) + 1,
+                                        min(int(pc[q]) + 256, base - 1))
+            if len(rec) == 0:
+                continue
+            frame = make_batch(
+                MsgKind.COMMIT, leader_id=self.me, inst=rec["inst"],
+                ballot=rec["ballot"], op=rec["op"], key=rec["key"],
+                val=rec["val"], cmd_id=rec["cmd_id"],
+                client_id=rec["client_id"])
+            self._send_or_redial(q, MsgKind.COMMIT, frame)

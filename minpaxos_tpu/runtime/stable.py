@@ -1,0 +1,149 @@
+"""Append-only durable log ("stable store") + replay.
+
+Counterpart of the reference's per-replica ``stable-store-replica<id>``
+file: 12-byte instance metadata + marshaled commands appended and
+fsync'd per accept (bareminpaxos.go:164-197), replayed wholesale on
+boot (getDataFromStableStore :122-161). Two deliberate upgrades:
+
+* **Batched records.** One protocol tick persists every slot it
+  accepted as one contiguous numpy write + one fsync, instead of a
+  write+sync per instance.
+* **Frontier records.** The reference never logs commit progress (a
+  revived replica rediscovers it from the leader); we append a tiny
+  frontier record when committed_upto advances so recovery can
+  re-execute the committed prefix locally and the leader can serve
+  beyond-window catch-up from its own log (models/minpaxos.py window
+  slide LIMIT note).
+
+The in-memory mirror (``self.slots``) doubles as the leader's
+beyond-retention resync source: reads never touch disk.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+MAGIC = b"MPXL0001"
+
+# one record per accepted slot
+SLOT_DT = np.dtype([
+    ("inst", "<i4"), ("ballot", "<i4"), ("status", "u1"), ("op", "u1"),
+    ("key", "<i8"), ("val", "<i8"), ("cmd_id", "<i4"), ("client_id", "<i4"),
+])
+_FRONTIER = struct.Struct("<i")  # committed_upto
+
+REC_SLOTS = 1  # payload: u32 count + count*SLOT_DT
+REC_FRONTIER = 2  # payload: i32
+_HDR = struct.Struct("<BI")  # record type, payload bytes
+
+
+class StableStore:
+    """Durable redo log for one replica.
+
+    File layout: MAGIC, then records of [type u8][len u32][payload].
+    ``sync=False`` trades durability for speed (the reference's
+    non--durable mode skips persistence entirely).
+    """
+
+    def __init__(self, path: str, sync: bool = True):
+        self.path = path
+        self.sync = sync
+        existed = os.path.exists(path) and os.path.getsize(path) > len(MAGIC)
+        self.slots: dict[int, np.void] = {}
+        self.frontier = -1
+        if existed:
+            self._replay()
+            self._f = open(path, "ab")
+        else:
+            self._f = open(path, "wb")
+            self._f.write(MAGIC)
+            self._f.flush()
+
+    @property
+    def recovered(self) -> bool:
+        return bool(self.slots) or self.frontier >= 0
+
+    # -- append --
+
+    def append_slots(self, inst, ballot, status, op, key, val, cmd_id,
+                     client_id) -> None:
+        n = len(inst)
+        if n == 0:
+            return
+        rec = np.zeros(n, SLOT_DT)
+        rec["inst"], rec["ballot"], rec["status"] = inst, ballot, status
+        rec["op"], rec["key"], rec["val"] = op, key, val
+        rec["cmd_id"], rec["client_id"] = cmd_id, client_id
+        payload = rec.tobytes()
+        self._f.write(_HDR.pack(REC_SLOTS, len(payload)))
+        self._f.write(payload)
+        for r in rec:
+            i = int(r["inst"])
+            old = self.slots.get(i)
+            if old is None or int(r["ballot"]) >= int(old["ballot"]):
+                self.slots[i] = r.copy()
+
+    def append_frontier(self, committed_upto: int) -> None:
+        if committed_upto <= self.frontier:
+            return
+        self.frontier = committed_upto
+        self._f.write(_HDR.pack(REC_FRONTIER, _FRONTIER.size))
+        self._f.write(_FRONTIER.pack(committed_upto))
+
+    def flush(self) -> None:
+        self._f.flush()
+        if self.sync:
+            os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._f.close()
+
+    # -- read --
+
+    def _replay(self) -> None:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if data[: len(MAGIC)] != MAGIC:
+            raise ValueError(f"{self.path}: bad magic")
+        pos = len(MAGIC)
+        while pos + _HDR.size <= len(data):
+            rtype, plen = _HDR.unpack_from(data, pos)
+            pos += _HDR.size
+            if pos + plen > len(data):
+                break  # torn tail write (crash mid-append): ignore
+            if rtype == REC_SLOTS and plen % SLOT_DT.itemsize == 0:
+                rec = np.frombuffer(data, SLOT_DT, plen // SLOT_DT.itemsize,
+                                    pos)
+                for r in rec:
+                    i = int(r["inst"])
+                    old = self.slots.get(i)
+                    if old is None or int(r["ballot"]) >= int(old["ballot"]):
+                        self.slots[i] = r.copy()
+            elif rtype == REC_FRONTIER and plen == _FRONTIER.size:
+                (fr,) = _FRONTIER.unpack_from(data, pos)
+                self.frontier = max(self.frontier, fr)
+            pos += plen
+
+    def committed_prefix(self) -> int:
+        """Largest f <= logged frontier with slots 0..f all present."""
+        f = -1
+        while f < self.frontier and (f + 1) in self.slots:
+            f += 1
+        return f
+
+    def read_range(self, lo: int, hi: int) -> np.ndarray:
+        """Slot records for inst in [lo, hi] that exist, ascending —
+        the leader's beyond-window catch-up source."""
+        out = [self.slots[i] for i in range(lo, hi + 1) if i in self.slots]
+        if not out:
+            return np.zeros(0, SLOT_DT)
+        return np.array(out, dtype=SLOT_DT)
+
+    def max_inst(self) -> int:
+        return max(self.slots) if self.slots else -1

@@ -1,0 +1,231 @@
+"""TCP transport: peer mesh + client listener for a replica process.
+
+Counterpart of the reference's genericsmr connection plumbing
+(genericsmr.go:125-400): full TCP mesh where the lower-id replica dials
+and the higher-id listens, a 1-byte connection-type handshake
+(CLIENT/PEER, genericsmrproto.go:16-17), per-connection buffered
+writers flushed once per batch, reconnect-on-failure both outbound
+(ReconnectToPeer :254-287) and inbound (peerReconnector :377-400).
+
+Threading: reader threads decode frames and enqueue
+``(src_kind, conn_id, kind, rows)`` onto one queue owned by the
+protocol thread; writes happen only from the protocol thread through
+``send``/``flush_all``. Single-owner by construction — the reference's
+benign data races (SURVEY.md section 5) cannot exist here.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+
+import numpy as np
+
+from minpaxos_tpu.utils.dlog import dlog
+from minpaxos_tpu.wire.codec import FrameWriter, StreamDecoder
+from minpaxos_tpu.wire.messages import MsgKind
+
+FROM_PEER = 0
+FROM_CLIENT = 1
+CONN_LOST = 2
+
+
+class _Conn:
+    __slots__ = ("sock", "writer", "alive")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.writer = FrameWriter(sock)
+        self.alive = True
+
+
+class Transport:
+    """Owns every socket of one replica process."""
+
+    def __init__(self, me: int, addrs: list[tuple[str, int]],
+                 inbox_queue: "queue.Queue | None" = None):
+        self.me = me
+        self.addrs = addrs  # data-port address of every replica, by id
+        self.n = len(addrs)
+        self.queue: queue.Queue = inbox_queue or queue.Queue()
+        self.peers: dict[int, _Conn] = {}
+        self.clients: dict[int, _Conn] = {}
+        # Client connection ids are globally unique across replicas
+        # (replica id in the high bits): command provenance travels
+        # through the log as (client_id, cmd_id), and a follower
+        # executing a leader-proposed command must never mistake the
+        # leader's conn id for one of its own.
+        self._next_client = me << 20
+        self._lock = threading.Lock()  # guards peers/clients maps only
+        self._listener: socket.socket | None = None
+        self._stop = threading.Event()
+        self._last_dial: dict[int, float] = {}
+
+    # -- lifecycle --
+
+    def listen(self) -> None:
+        host, port = self.addrs[self.me]
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port))
+        s.listen(64)
+        self._listener = s
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def connect_peers(self) -> None:
+        """Dial every lower-id peer (higher ids dial us); the handshake
+        byte + our id identifies us on the other side."""
+        for q in range(self.me):
+            self.dial_peer(q)
+
+    def dial_peer(self, q: int, rate_limit_s: float = 0.5) -> bool:
+        """(Re)connect to peer q; rate-limited so a dead peer doesn't
+        stall the protocol tick with back-to-back connect timeouts."""
+        now = time.monotonic()
+        if now - self._last_dial.get(q, -1e9) < rate_limit_s:
+            return False
+        self._last_dial[q] = now
+        try:
+            sock = socket.create_connection(self.addrs[q], timeout=1.0)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(bytes([int(MsgKind.HANDSHAKE_PEER), self.me]))
+        except OSError:
+            return False
+        self._install_peer(q, sock)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self.peers.values()) + list(self.clients.values())
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+
+    # -- accept / read --
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._handshake, args=(sock,),
+                             daemon=True).start()
+
+    def _handshake(self, sock) -> None:
+        """First byte: connection type; peers send their id next."""
+        try:
+            t = sock.recv(1)
+            if not t:
+                sock.close()
+                return
+            t = t[0]
+            if t == int(MsgKind.HANDSHAKE_PEER):
+                pid = sock.recv(1)
+                if not pid:
+                    sock.close()
+                    return
+                self._install_peer(pid[0], sock)
+            elif t == int(MsgKind.HANDSHAKE_CLIENT):
+                with self._lock:
+                    cid = self._next_client
+                    self._next_client += 1
+                    self.clients[cid] = conn = _Conn(sock)
+                threading.Thread(
+                    target=self._read_loop,
+                    args=(FROM_CLIENT, cid, conn), daemon=True).start()
+            else:
+                sock.close()
+        except OSError:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _install_peer(self, q: int, sock) -> None:
+        with self._lock:
+            old = self.peers.get(q)
+            self.peers[q] = conn = _Conn(sock)
+        if old is not None:
+            try:
+                old.sock.close()
+            except OSError:
+                pass
+        dlog(f"replica {self.me}: peer {q} connected")
+        threading.Thread(target=self._read_loop,
+                         args=(FROM_PEER, q, conn), daemon=True).start()
+
+    def _read_loop(self, src_kind: int, conn_id: int, conn: _Conn) -> None:
+        dec = StreamDecoder()
+        sock = conn.sock
+        while not self._stop.is_set():
+            try:
+                chunk = sock.recv(1 << 16)
+            except OSError:
+                break
+            if not chunk:
+                break
+            try:
+                frames = dec.feed(chunk)
+            except ValueError:
+                break
+            for kind, rows in frames:
+                self.queue.put((src_kind, conn_id, kind, rows))
+            if dec.error is not None:
+                break
+        conn.alive = False
+        self.queue.put((CONN_LOST, conn_id if src_kind == FROM_CLIENT
+                        else -1 - conn_id, None, None))
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    # -- write (protocol thread only) --
+
+    def send_peer(self, q: int, kind: MsgKind, rows: np.ndarray) -> bool:
+        conn = self.peers.get(q)
+        if conn is None or not conn.alive:
+            return False
+        try:
+            conn.writer.write(kind, rows)
+            return True
+        except OSError:
+            conn.alive = False
+            return False
+
+    def send_client(self, cid: int, kind: MsgKind, rows: np.ndarray) -> bool:
+        conn = self.clients.get(cid)
+        if conn is None or not conn.alive:
+            return False
+        try:
+            conn.writer.write(kind, rows)
+            return True
+        except OSError:
+            conn.alive = False
+            return False
+
+    def flush_all(self) -> None:
+        with self._lock:
+            conns = list(self.peers.items()) + list(self.clients.items())
+        for _, conn in conns:
+            if conn.alive:
+                try:
+                    conn.writer.flush()
+                except OSError:
+                    conn.alive = False
+
+    def peer_alive(self, q: int) -> bool:
+        conn = self.peers.get(q)
+        return conn is not None and conn.alive

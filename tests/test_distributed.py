@@ -1,0 +1,182 @@
+"""Distributed-runtime integration: real master + replica servers +
+clients over localhost TCP, in-process (threads).
+
+Programmatic equivalents of the reference's shell matrix (SURVEY.md
+section 4): run.sh boot, simpletest.sh smoke, checklog.sh follower
+kill/revive with -durable, leaderelectiontestmaster.sh leader kill +
+master-driven election + client failover.
+"""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+from minpaxos_tpu.runtime.client import Client, gen_workload
+from minpaxos_tpu.runtime.master import Master, get_leader
+from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+SMALL = dict(window=1 << 10, inbox=1024, exec_batch=512, kv_pow2=12,
+             catchup_rows=64, recovery_rows=64)
+
+
+class Harness:
+    """Boot master + N replicas on fresh localhost ports."""
+
+    def __init__(self, tmp_path, n=3, durable=False, thrifty=False):
+        # data ports must leave room for control ports (+1000)
+        base = free_ports(1)[0]
+        self.ports = free_ports(n + 1)
+        self.mport = self.ports[0]
+        self.addrs = [("127.0.0.1", p) for p in self.ports[1:]]
+        self.master = Master("127.0.0.1", self.mport, n, ping_s=0.3)
+        self.master.start()
+        # register every replica (the CLI binary's startup step)
+        from minpaxos_tpu.runtime.master import register_with_master
+        for host, port in self.addrs:
+            register_with_master(("127.0.0.1", self.mport), host, port,
+                                 timeout_s=5.0)
+        self.cfg = MinPaxosConfig(n_replicas=n, **SMALL)
+        self.flags = lambda: RuntimeFlags(
+            durable=durable, thrifty=thrifty, store_dir=str(tmp_path),
+            tick_s=0.001)
+        self.servers: dict[int, ReplicaServer] = {}
+        for i in range(n):
+            self.start_replica(i)
+        # let replica 0 self-elect and prepare
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(bool(np.asarray(s.state.prepared)) or i != 0
+                   for i, s in self.servers.items()) and bool(
+                    np.asarray(self.servers[0].state.prepared)):
+                break
+            time.sleep(0.05)
+
+    def start_replica(self, i) -> None:
+        s = ReplicaServer(i, self.addrs, self.cfg, self.flags())
+        s.start()
+        self.servers[i] = s
+
+    def kill(self, i) -> None:
+        self.servers.pop(i).stop()
+
+    def stop(self) -> None:
+        for s in self.servers.values():
+            s.stop()
+        self.master.stop()
+
+    def client(self, check=True) -> Client:
+        return Client(("127.0.0.1", self.mport), check=check)
+
+
+@pytest.fixture
+def harness(tmp_path):
+    h = None
+
+    def make(**kw):
+        nonlocal h
+        h = Harness(tmp_path, **kw)
+        return h
+
+    yield make
+    if h is not None:
+        h.stop()
+
+
+def test_simpletest_smoke(harness):
+    """simpletest.sh: 1000 requests, exactly once."""
+    h = harness()
+    cli = h.client()
+    ops, keys, vals = gen_workload(1000, seed=42)
+    stats = cli.run_workload(ops, keys, vals, timeout_s=30)
+    assert stats["acked"] == 1000, stats
+    assert stats["duplicates"] == 0
+    cli.close_conn()
+
+
+def test_reads_are_served(harness):
+    """READ frames (parse-and-dropped by the reference) are served as
+    linearizable GETs through the log."""
+    h = harness()
+    cli = h.client()
+    stats = cli.run_workload(np.array([1]), np.array([77]), np.array([123]),
+                             timeout_s=15)
+    assert stats["acked"] == 1
+    cli.read([1000], [77])
+    assert cli.wait([1000], timeout_s=10)
+    assert cli.replies[1000]["val"] == 123
+    cli.close_conn()
+
+
+def test_follower_kill_revive_durable(harness, tmp_path):
+    """checklog.sh: kill follower under load, keep committing, revive
+    with the stable store, verify it catches back up."""
+    h = harness(durable=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(300, seed=1)
+    assert cli.run_workload(ops, keys, vals, timeout_s=30)["acked"] == 300
+    h.kill(2)
+    ops2, keys2, vals2 = gen_workload(300, seed=2)
+    cli.replies.clear()
+    assert cli.run_workload(ops2, keys2, vals2, timeout_s=30)["acked"] == 300
+    # revive from its stable store; leader catch-up heals the gap
+    h.start_replica(2)
+    leader = h.servers[0]
+    deadline = time.monotonic() + 20
+    target = int(np.asarray(leader.state.committed_upto))
+    while time.monotonic() < deadline:
+        got = int(np.asarray(h.servers[2].state.committed_upto))
+        if got >= target:
+            break
+        time.sleep(0.1)
+    assert int(np.asarray(h.servers[2].state.committed_upto)) >= target
+    cli.close_conn()
+
+
+def test_leader_kill_election_failover(harness):
+    """leaderelectiontestmaster.sh + client+killprocess.sh: kill the
+    leader; master promotes a live replica; the client fails over and
+    finishes the workload with no duplicates."""
+    h = harness()
+    cli = h.client()
+    ops, keys, vals = gen_workload(200, seed=3)
+    assert cli.run_workload(ops, keys, vals, timeout_s=30)["acked"] == 200
+    h.kill(0)
+    # master ping loop notices and promotes the highest-frontier replica
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if h.master.leader != 0:
+            break
+        time.sleep(0.1)
+    assert h.master.leader != 0
+    cli.replies.clear()
+    ops2, keys2, vals2 = gen_workload(200, seed=4)
+    stats = cli.run_workload(ops2, keys2, vals2, timeout_s=30)
+    assert stats["acked"] == 200, stats
+    assert stats["duplicates"] == 0
+    cli.close_conn()
+
+
+def test_thrifty_still_commits(harness):
+    h = harness(thrifty=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(200, seed=5)
+    stats = cli.run_workload(ops, keys, vals, timeout_s=30)
+    assert stats["acked"] == 200, stats
+    cli.close_conn()

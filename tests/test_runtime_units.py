@@ -1,0 +1,142 @@
+"""Unit tests for the host runtime pieces: frame<->column packing and
+the durable stable store."""
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.runtime import batches
+from minpaxos_tpu.runtime.stable import SLOT_DT, StableStore
+from minpaxos_tpu.wire.messages import MsgKind, make_batch
+
+
+def test_column_buffer_fill_and_drain():
+    buf = batches.ColumnBuffer(8)
+    buf.append(3, kind=1, inst=np.array([5, 6, 7]), ballot=9)
+    assert buf.fill == 3
+    cols, n = buf.drain()
+    assert n == 3
+    np.testing.assert_array_equal(cols["inst"][:3], [5, 6, 7])
+    assert (cols["ballot"][:3] == 9).all()
+    assert buf.fill == 0 and buf.cols["inst"].sum() == 0
+
+
+def test_column_buffer_overflow_drops():
+    buf = batches.ColumnBuffer(4)
+    buf.append(6, kind=1, inst=np.arange(6))
+    assert buf.fill == 4 and buf.dropped == 2
+
+
+def test_propose_frame_to_rows_splits_i64():
+    buf = batches.ColumnBuffer(16)
+    key = np.array([(1 << 40) + 7, -3], dtype=np.int64)
+    frame = make_batch(MsgKind.PROPOSE, cmd_id=np.array([1, 2]), op=1,
+                       key=key, val=np.array([10, 20]), timestamp=0)
+    batches.frame_to_rows(buf, MsgKind.PROPOSE, frame, conn_id=42)
+    cols, n = buf.drain()
+    assert n == 2
+    from minpaxos_tpu.ops.packed import join_i64
+
+    np.testing.assert_array_equal(
+        join_i64(cols["key_hi"][:2], cols["key_lo"][:2]), key)
+    assert (cols["client_id"][:2] == 42).all()
+    assert (cols["kind"][:2] == int(MsgKind.PROPOSE)).all()
+
+
+def test_accept_reply_run_length_roundtrip():
+    """Per-slot acks -> (inst, count) runs on the wire -> per-slot rows."""
+    cols = {c: np.zeros(10, np.int32) for c in batches.COLS}
+    # two runs: slots 5..8 ok at ballot 3 from replica 1; slot 20 nack
+    cols["kind"][:5] = int(MsgKind.ACCEPT_REPLY)
+    cols["inst"][:5] = [5, 6, 7, 8, 20]
+    cols["ballot"][:5] = [3, 3, 3, 3, 7]
+    cols["op"][:5] = [1, 1, 1, 1, 0]
+    cols["src"][:5] = 1
+    cols["last_committed"][:5] = 4
+    frames = batches.rows_to_frames(cols, cols["kind"] != 0)
+    assert len(frames) == 1
+    kind, frame = frames[0]
+    assert kind == MsgKind.ACCEPT_REPLY
+    assert len(frame) == 2  # compressed to 2 runs
+    np.testing.assert_array_equal(sorted(frame["count"]), [1, 4])
+    # expand back
+    buf = batches.ColumnBuffer(16)
+    batches.frame_to_rows(buf, MsgKind.ACCEPT_REPLY, frame, conn_id=0)
+    out, n = buf.drain()
+    assert n == 5
+    np.testing.assert_array_equal(np.sort(out["inst"][:5]), [5, 6, 7, 8, 20])
+
+
+def test_accept_frame_roundtrip():
+    cols = {c: np.zeros(4, np.int32) for c in batches.COLS}
+    cols["kind"][:3] = int(MsgKind.ACCEPT)
+    cols["src"][:3] = 2
+    cols["inst"][:3] = [9, 10, 11]
+    cols["ballot"][:3] = 17
+    cols["last_committed"][:3] = 8
+    cols["op"][:3] = 1
+    cols["key_lo"][:3] = [1, 2, 3]
+    cols["val_lo"][:3] = [4, 5, 6]
+    cols["cmd_id"][:3] = [100, 101, 102]
+    cols["client_id"][:3] = 55
+    frames = batches.rows_to_frames(cols, cols["kind"] != 0)
+    (kind, frame), = frames
+    assert kind == MsgKind.ACCEPT and len(frame) == 3
+    buf = batches.ColumnBuffer(8)
+    batches.frame_to_rows(buf, kind, frame, conn_id=0)
+    out, n = buf.drain()
+    assert n == 3
+    for c in ("inst", "ballot", "last_committed", "op", "key_lo", "val_lo",
+              "cmd_id", "client_id"):
+        np.testing.assert_array_equal(out[c][:3], cols[c][:3], err_msg=c)
+
+
+def test_stable_store_roundtrip(tmp_path):
+    path = str(tmp_path / "store")
+    s = StableStore(path, sync=True)
+    s.append_slots(np.arange(5), np.full(5, 16), np.full(5, 3),
+                   np.ones(5), np.arange(5) * 10, np.arange(5) * 100,
+                   np.arange(5), np.zeros(5))
+    s.append_frontier(3)
+    s.flush()
+    s.close()
+    r = StableStore(path)
+    assert r.recovered
+    assert r.frontier == 3
+    assert r.committed_prefix() == 3
+    assert r.max_inst() == 4
+    rec = r.read_range(1, 3)
+    np.testing.assert_array_equal(rec["inst"], [1, 2, 3])
+    np.testing.assert_array_equal(rec["val"], [100, 200, 300])
+    r.close()
+
+
+def test_stable_store_ballot_supersede(tmp_path):
+    path = str(tmp_path / "store")
+    s = StableStore(path)
+    s.append_slots([7], [16], [3], [1], [1], [111], [0], [0])
+    s.append_slots([7], [32], [3], [1], [2], [222], [1], [0])  # higher ballot
+    s.append_slots([7], [16], [3], [1], [3], [333], [2], [0])  # stale: ignored
+    s.flush()
+    s.close()
+    r = StableStore(path)
+    rec = r.read_range(7, 7)
+    assert int(rec["ballot"][0]) == 32 and int(rec["val"][0]) == 222
+    r.close()
+
+
+def test_stable_store_torn_tail(tmp_path):
+    """A crash mid-append leaves a torn record; replay must ignore it."""
+    path = str(tmp_path / "store")
+    s = StableStore(path)
+    s.append_slots(np.arange(3), np.full(3, 16), np.full(3, 3),
+                   np.ones(3), np.arange(3), np.arange(3), np.arange(3),
+                   np.zeros(3))
+    s.append_frontier(2)
+    s.flush()
+    s.close()
+    with open(path, "ab") as f:
+        f.write(b"\x01\xff\xff")  # garbage half-header/payload
+    r = StableStore(path)
+    assert r.committed_prefix() == 2
+    assert len(r.read_range(0, 10)) == 3
+    r.close()
