@@ -455,6 +455,18 @@ def replica_step_impl(
                            inbox.last_committed, -1))
 
     # ---- 3. COMMIT rows (explicit per-slot commit, cold path) ----
+    # A replica with no known leader (revived with an empty store into
+    # a quiescent cluster) adopts the committer as its leader hint, so
+    # the frontier-report gossip (7b) has a destination and host-side
+    # catch-up can make progress instead of livelocking.
+    com_any = (is_commit | is_cshort).any()
+    com_bal = jnp.max(jnp.where(is_commit | is_cshort, inbox.ballot, NO_BALLOT))
+    com_src = inbox.src[
+        jnp.argmax(jnp.where(is_commit | is_cshort, inbox.ballot, NO_BALLOT))]
+    adopt_com = com_any & (state.leader_id < 0) & (
+        com_bal >= state.default_ballot)
+    state = state._replace(
+        leader_id=jnp.where(adopt_com, com_src, state.leader_id))
     rel_c, in_win_c = _rel(state, inbox.inst, S)
     com_ok = is_commit & in_win_c
     tgt_c = jnp.where(com_ok, rel_c, S)
@@ -546,14 +558,22 @@ def replica_step_impl(
     tgt_r = jnp.where(ar_ok, rel_r, S)
     reply_src = jnp.where(is_accept_reply | is_prep_reply,
                           jnp.clip(inbox.src, 0, R - 1), R)
+    # peer_commits ADOPTS the batch-max report per peer rather than
+    # taking a running max: a crash-revived peer reports a frontier
+    # LOWER than what we remember, and a monotone max would pin
+    # catch-up past its real gap forever. Reports are monotone per
+    # source within one process lifetime (TCP-ordered), so adoption
+    # only regresses across a real crash — exactly when it must.
+    pc_seen = jnp.full(R + 1, jnp.int32(-(2 ** 30))).at[reply_src].max(
+        inbox.last_committed)
+    replied = pc_seen[:R] > -(2 ** 30)
     state = state._replace(
         votes=state.votes.at[tgt_r, jnp.clip(inbox.src, 0, R - 1)].set(
             True, mode="drop"),
         max_recv_ballot=jnp.maximum(
             state.max_recv_ballot,
             jnp.max(jnp.where(is_accept_reply, inbox.ballot, NO_BALLOT))),
-        peer_commits=state.peer_commits.at[reply_src].max(
-            inbox.last_committed, mode="drop"),
+        peer_commits=jnp.where(replied, pc_seen[:R], state.peer_commits),
     )
 
     # ---- 7. commit scan ----
@@ -573,26 +593,47 @@ def replica_step_impl(
         committed_upto=jnp.maximum(state.committed_upto,
                                    frontier_rel + state.window_base))
 
-    # ---- 7b. frontier broadcast + stall tracking ----
+    # ---- 7b. frontier gossip + stall tracking ----
     # The reference's followers only learn commitment from the NEXT
     # Accept's piggyback (SURVEY.md section 3.2), stalling their exec
-    # cursor when traffic pauses. Here the leader appends one broadcast
-    # COMMIT_SHORT row whenever its frontier advances; cost is one row.
-    advanced = state.is_leader & (state.committed_upto > old_upto)
+    # cursor when traffic pauses. Here ONE appended row closes the loop
+    # in both directions:
+    # * leader: broadcast COMMIT_SHORT whenever its frontier advances;
+    # * follower: an ACCEPT_REPLY frontier report to the leader when
+    #   its frontier advances OR it received commit-ish traffic without
+    #   advancing. The second clause is load-bearing: a revived replica
+    #   being healed by host-side COMMIT rows (runtime _host_catchup)
+    #   would otherwise never ack, the leader's peer_commits would
+    #   never leave -1, and catch-up would re-serve the same prefix
+    #   forever (peer_commits only updates from reply rows).
+    advanced = state.committed_upto > old_upto
     in_flight = state.crt_inst - 1 > state.committed_upto
     state = state._replace(
         tick=state.tick + 1,
         stall_ticks=jnp.where(
             state.is_leader & state.prepared & in_flight & ~advanced,
             state.stall_ticks + 1, 0))
+    lead_adv = state.is_leader & advanced
+    got_committy = (is_accept | is_commit | is_cshort | is_pir).any()
+    fol_report = (~state.is_leader) & (state.leader_id >= 0) & (
+        advanced | got_committy)
     fb = MsgBatch.empty(1)
     fb = fb._replace(
-        kind=jnp.where(advanced, int(MsgKind.COMMIT_SHORT), 0)[None].astype(
-            jnp.int32),
+        kind=jnp.where(lead_adv, int(MsgKind.COMMIT_SHORT),
+                       jnp.where(fol_report, int(MsgKind.ACCEPT_REPLY),
+                                 0))[None].astype(jnp.int32),
         src=jnp.full(1, state.me, jnp.int32),
         ballot=jnp.full(1, state.default_ballot, jnp.int32),
+        inst=jnp.maximum(state.committed_upto, 0)[None],
+        # op=0: the report must NOT read as an accept ack — op>0 would
+        # register a phantom vote at the leader for a slot this replica
+        # never accepted (peer_commits adoption ignores op; only the
+        # vote path checks it)
+        op=jnp.zeros(1, jnp.int32),
         last_committed=jnp.full(1, state.committed_upto, jnp.int32),
     )
+    fb_dst = jnp.where(lead_adv, jnp.int32(-1),
+                       jnp.clip(state.leader_id, 0, R - 1))[None]
 
     # ---- 7c. catch-up (CatchUpLog, bareminpaxos.go:488-513) ----
     # One peer per step, round-robin: if its known frontier trails
@@ -676,7 +717,7 @@ def replica_step_impl(
     dst = jnp.concatenate([
         dst,
         jnp.full(K2, prep_src, jnp.int32),  # recovery suffix -> new leader
-        jnp.full(1, -1, jnp.int32),  # frontier broadcast
+        fb_dst.astype(jnp.int32),  # frontier gossip (bcast / to leader)
         jnp.full(K, peer, jnp.int32),  # catch-up -> laggard
         jnp.full(K, -1, jnp.int32),  # retry broadcast
     ])
@@ -722,18 +763,13 @@ def replica_step_impl(
     # addressing slid-out slots simply drop (they were executed).
     if cfg.slide_window:
         retention = cfg.retention if cfg.retention >= 0 else S // 2
-        others = jnp.arange(R) != state.me
-        peer_floor = jnp.min(
-            jnp.where(others, state.peer_commits, jnp.int32(2**30))) + 1
         exec_edge = state.executed_upto + 1
         # Everyone retains up to `retention` executed slots: any replica
         # may become leader later and must be able to serve catch-up
-        # for that span. The current leader additionally holds slots
-        # the slowest peer still needs (within the same cap).
-        target = jnp.maximum(exec_edge - retention,
-                             jnp.where(state.is_leader,
-                                       jnp.minimum(exec_edge, peer_floor),
-                                       exec_edge - retention))
+        # for that span. Peers lagging beyond retention are routed to
+        # the host stable-store path (runtime/replica.py _host_catchup),
+        # so no replica needs to retain more than this uniform span.
+        target = exec_edge - retention
         shift = jnp.clip(target - state.window_base, 0, S)
         idx1 = jnp.arange(S, dtype=jnp.int32)
         gone = idx1 >= (S - shift)

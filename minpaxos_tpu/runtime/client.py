@@ -101,8 +101,11 @@ class Client:
                 break
             if not chunk:
                 break
-            for kind, rows in dec.feed(chunk):
-                self._on_frame(kind, rows)
+            try:
+                for kind, rows in dec.feed(chunk):
+                    self._on_frame(kind, rows)
+            except ValueError:
+                break  # corrupt frame: close and let failover re-dial
             if dec.error is not None:
                 break
         with self._got:

@@ -162,15 +162,23 @@ class Master:
                         continue
                     _, neg = max(cand)
                     new_leader = -neg
-                    self.leader = new_leader
                     host, port = self.nodes[new_leader]
                 else:
                     continue
             dlog(f"master: leader {leader} dead -> promoting {new_leader}")
+            # commit the promotion only once the be_the_leader RPC
+            # lands — recording it first and swallowing a failed RPC
+            # would wedge the cluster on a phantom leader (the promoted
+            # replica never elects, yet answers pings, so leader_dead
+            # stays false forever); on failure the next ping round
+            # re-elects
             try:
                 _rpc((host, port + 1000), {"m": "be_the_leader"}, timeout=2.0)
             except (OSError, json.JSONDecodeError):
-                pass
+                continue
+            with self._lock:
+                if self.leader == leader:  # no concurrent re-election
+                    self.leader = new_leader
 
 
 def register_with_master(maddr: tuple[str, int], my_host: str, my_port: int,

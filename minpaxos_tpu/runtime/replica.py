@@ -37,7 +37,7 @@ from minpaxos_tpu.models.minpaxos import (
     MsgBatch,
     become_leader,
     init_replica,
-    replica_step_impl,
+    replica_step,
 )
 from minpaxos_tpu.ops.packed import join_i64, split_i64
 from minpaxos_tpu.runtime import batches
@@ -82,9 +82,11 @@ class ReplicaServer:
         self.flags = flags or RuntimeFlags()
         self.transport = Transport(me, addrs)
         self.queue = self.transport.queue
-        self.step = jax.jit(
-            functools.partial(replica_step_impl, self.cfg),
-            donate_argnums=0)
+        # the MODULE-level jitted step (static cfg): every replica in
+        # the process shares ONE compile cache — N private jax.jit
+        # wrappers would compile the same kernel N times concurrently,
+        # which starves small hosts (in-process test clusters)
+        self.step = functools.partial(replica_step, self.cfg)
         # copy every leaf: jax caches/aliases equal small constants, and
         # donation rejects the same buffer appearing twice
         self.state = jax.tree_util.tree_map(
@@ -102,6 +104,14 @@ class ReplicaServer:
         self.stats = {"ticks": 0, "committed": 0, "executed": 0,
                       "proposals": 0}
         self._ctl_sock: socket.socket | None = None
+        self._proto_thread: threading.Thread | None = None
+        self._idle = False  # last step produced no work (throttle ticks)
+        self._last_step = 0.0
+        # control-plane snapshot: the protocol thread swaps in a fresh
+        # plain-Python dict each tick; other threads only ever read it.
+        # They must NOT touch self.state — its arrays are donated into
+        # the jitted step and die mid-tick.
+        self.snapshot = {"frontier": -1, "leader": -1, "prepared": False}
 
     # ---------------- lifecycle ----------------
 
@@ -111,12 +121,18 @@ class ReplicaServer:
         if self._recovered:
             self._recover_from_store()
         self.transport.connect_peers()
-        threading.Thread(target=self._run, daemon=True).start()
+        self._proto_thread = threading.Thread(target=self._run, daemon=True)
+        self._proto_thread.start()
         if self.flags.beacon:
             threading.Thread(target=self._beacon_loop, daemon=True).start()
 
     def stop(self) -> None:
+        # order matters: signal, JOIN the protocol thread (it may be
+        # mid-_persist), and only then close the store — the reference's
+        # single event-loop goroutine gets this for free
         self._stop.set()
+        if self._proto_thread is not None:
+            self._proto_thread.join(timeout=10.0)
         self.transport.stop()
         if self._ctl_sock is not None:
             try:
@@ -181,7 +197,18 @@ class ReplicaServer:
         host, port = self.addrs[self.me]
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((host, port + 1000))
+        # retry: the control port (data port + 1000, the reference's
+        # scheme) can transiently collide with an ephemeral outbound
+        # port (e.g. a master ping's source port); those clear quickly
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                s.bind((host, port + 1000))
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.25)
         s.listen(16)
         self._ctl_sock = s
         threading.Thread(target=self._control_loop, daemon=True).start()
@@ -205,10 +232,9 @@ class ReplicaServer:
                     break
                 m = req.get("m")
                 if m == "ping":
-                    resp = {"ok": True,
-                            "frontier": int(np.asarray(self.state.committed_upto)),
-                            "leader": int(np.asarray(self.state.leader_id)),
-                            "stats": self.stats}
+                    snap = self.snapshot  # one read: dict swap is atomic
+                    resp = {"ok": True, "frontier": snap["frontier"],
+                            "leader": snap["leader"], "stats": self.stats}
                 elif m == "be_the_leader":
                     self.queue.put((CONTROL, 0, "be_the_leader", None))
                     resp = {"ok": True}
@@ -252,7 +278,7 @@ class ReplicaServer:
     def _wait_for_peers(self, timeout_s: float = 15.0) -> None:
         deadline = time.monotonic() + timeout_s
         need = self.cfg.n_replicas - 1
-        while time.monotonic() < deadline:
+        while time.monotonic() < deadline and not self._stop.is_set():
             n = sum(self.transport.peer_alive(q)
                     for q in range(self.cfg.n_replicas) if q != self.me)
             if n >= need:
@@ -263,10 +289,20 @@ class ReplicaServer:
             time.sleep(0.05)
 
     def _tick(self) -> None:
-        elect = self._drain(self.flags.tick_s)
+        # idle throttle: a quiet replica (empty inbox, no output, no
+        # pending execution last step) steps at ~20Hz instead of every
+        # tick_s — incoming messages still trigger an immediate step
+        # via the queue wakeup. Keeps an idle N-replica in-process
+        # cluster from saturating small hosts with no-op device steps.
+        timeout = 0.03 if self._idle else self.flags.tick_s
+        elect = self._drain(timeout)
+        if (self._idle and not elect and self.inbox.fill == 0
+                and time.monotonic() - self._last_step < 0.05):
+            return
         if elect:
             self._become_leader()
         self._device_tick(self.inbox)
+        self._last_step = time.monotonic()
         self.stats["ticks"] += 1
 
     def _drain(self, timeout_s: float) -> bool:
@@ -289,7 +325,9 @@ class ReplicaServer:
                     int(rows["rid"][0]), MsgKind.BEACON_REPLY, rows)
             elif kind == MsgKind.BEACON_REPLY:
                 rtt = cputicks() - int(rows["timestamp"][0])
-                q = int(rows["rid"][0])
+                # the replier echoes the beacon unchanged, so rid is OUR
+                # id; the peer is the connection the reply came in on
+                q = conn_id if src_kind == FROM_PEER else int(rows["rid"][0])
                 if q != self.me:
                     old = self.rtt_ewma[q]
                     self.rtt_ewma[q] = (rtt if np.isinf(old)
@@ -350,6 +388,13 @@ class ReplicaServer:
             self._reply(execr, out_cols, dst)
             self._host_catchup()
             self.transport.flush_all()
+        self._idle = (n_rows == 0 and not (out_cols["kind"] != 0).any()
+                      and int(np.asarray(execr.count)) == 0)
+        self.snapshot = {
+            "frontier": int(np.asarray(self.state.committed_upto)),
+            "leader": int(np.asarray(self.state.leader_id)),
+            "prepared": bool(np.asarray(self.state.prepared)),
+        }
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
 

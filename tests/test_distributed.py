@@ -59,12 +59,12 @@ class Harness:
         self.servers: dict[int, ReplicaServer] = {}
         for i in range(n):
             self.start_replica(i)
-        # let replica 0 self-elect and prepare
+        # let replica 0 self-elect and prepare (read via the published
+        # snapshot — replica.state is donated into the jitted step and
+        # must never be touched from another thread)
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
-            if all(bool(np.asarray(s.state.prepared)) or i != 0
-                   for i, s in self.servers.items()) and bool(
-                    np.asarray(self.servers[0].state.prepared)):
+            if self.servers[0].snapshot["prepared"]:
                 break
             time.sleep(0.05)
 
@@ -137,15 +137,13 @@ def test_follower_kill_revive_durable(harness, tmp_path):
     assert cli.run_workload(ops2, keys2, vals2, timeout_s=30)["acked"] == 300
     # revive from its stable store; leader catch-up heals the gap
     h.start_replica(2)
-    leader = h.servers[0]
     deadline = time.monotonic() + 20
-    target = int(np.asarray(leader.state.committed_upto))
+    target = h.servers[0].snapshot["frontier"]
     while time.monotonic() < deadline:
-        got = int(np.asarray(h.servers[2].state.committed_upto))
-        if got >= target:
+        if h.servers[2].snapshot["frontier"] >= target:
             break
         time.sleep(0.1)
-    assert int(np.asarray(h.servers[2].state.committed_upto)) >= target
+    assert h.servers[2].snapshot["frontier"] >= target
     cli.close_conn()
 
 
