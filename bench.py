@@ -9,7 +9,18 @@ so ``vs_baseline`` is measured against the driver's north-star target:
 committed-instances/sec pod-wide == 12.5M/sec/chip.
 vs_baseline = throughput / 12.5M (1.0 == north star hit).
 
-Note: steps are dispatched with a block_until_ready each — the remote
+Latency is MEASURED per slot, not inferred: each step records the
+leader's per-shard (committed_upto, crt_inst) cursors, so every slot's
+injection step and commit step are known exactly; p50/p99 are computed
+over all slots injected and committed inside the measured phase.
+
+Resilience: the TPU tunnel backend can hang or crash on init
+(BENCH_r01.json). Backend init runs in a watchdog thread with a bounded
+number of retries; on persistent failure the bench emits a structured
+failure JSON record (never a raw traceback), falling back to the CPU
+backend when possible so a number still lands.
+
+Note: steps are dispatched with a block_until_ready each -- the remote
 TPU tunnel degrades badly under deep async dispatch queues, and
 blocking also makes the latency numbers honest.
 
@@ -20,23 +31,121 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
-
-import jax
-import numpy as np
 
 
 def _progress(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
-from minpaxos_tpu.models.minpaxos import MinPaxosConfig
-from minpaxos_tpu.parallel.sharded import ShardedCluster
 
 NORTH_STAR_PER_CHIP = 100_000_000 / 8  # 1M inst / 10ms / 8 chips
 
 
+def _emit(result: dict) -> None:
+    print(json.dumps(result))
+
+
+def _failure(stage: str, err: str) -> None:
+    _emit({
+        "metric": "committed_instances_per_sec",
+        "value": 0.0,
+        "unit": "instances/sec",
+        "vs_baseline": 0.0,
+        "error": f"{stage}: {err[:500]}",
+        "platform": "none",
+        "baseline": "north-star 12.5e6 inst/s/chip",
+    })
+
+
+def _init_backend(retries: int = 2, timeout_s: float = 120.0):
+    """Initialize a JAX backend defensively. The tunnel's TPU backend
+    can hang on init *holding the global backend lock* — once that
+    happens in-process, even jax.devices("cpu") blocks forever. So the
+    default backend is probed in a SUBPROCESS with a timeout first; the
+    in-process backend is only initialized down a path the probe proved
+    alive, else the CPU platform is pinned before any backend touch."""
+    import os
+    import subprocess
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        # explicit operator choice; sitecustomize may have pinned the
+        # config elsewhere, so re-assert it (this is what lets
+        # `JAX_PLATFORMS=cpu python bench.py` work under the tunnel)
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+        return jax.devices()
+
+    ok = False
+    import signal
+    import tempfile
+
+    for attempt in range(retries):
+        # Popen + DEVNULL + process-group kill, NOT subprocess.run with
+        # capture_output: a hung backend init can leave grandchildren
+        # (tunnel helpers) holding the output pipes, and run()'s
+        # post-kill communicate() then blocks forever
+        with tempfile.NamedTemporaryFile("r", suffix=".probe") as tf:
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax, pathlib; pathlib.Path("
+                 f"{tf.name!r}).write_text(jax.devices()[0].platform)"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                start_new_session=True)
+            try:
+                rc = p.wait(timeout=timeout_s)
+                platform = tf.read().strip()
+                if rc == 0 and platform:
+                    _progress(f"probe: default backend alive ({platform})")
+                    ok = True
+                    break
+                _progress(f"probe attempt {attempt}: rc={rc}")
+            except subprocess.TimeoutExpired:
+                _progress(f"probe attempt {attempt}: hung > {timeout_s}s")
+                try:
+                    import os as _os
+                    _os.killpg(_os.getpgid(p.pid), signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+        time.sleep(2.0)
+
+    if not ok:
+        _progress("default backend unavailable; pinning cpu")
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:
+            _failure("backend-init", repr(e))
+            sys.exit(0)
+        return jax.devices()
+
+    # probe said alive — still guard the in-process init with a
+    # watchdog; if it hangs anyway the lock is poisoned and the only
+    # honest outcome is a structured failure record
+    result: list = []
+    t = threading.Thread(target=lambda: result.append(jax.devices()),
+                         daemon=True)
+    t.start()
+    t.join(timeout=timeout_s + 60)
+    if not result:
+        _failure("backend-init", "in-process init hung after live probe")
+        sys.exit(0)
+    return result[0]
+
+
 def main() -> None:
-    platform = jax.devices()[0].platform
+    devices = _init_backend()
+    import jax
+    import numpy as np
+
+    from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+    from minpaxos_tpu.parallel.sharded import ShardedCluster, shard_cursors
+
+    platform = devices[0].platform
     on_tpu = platform not in ("cpu",)
     # shards x window = concurrent instances resident per chip
     g, w, p, steps = (128, 4096, 512, 100) if on_tpu else (8, 512, 64, 20)
@@ -44,61 +153,101 @@ def main() -> None:
         n_replicas=5, window=w, inbox=4 * p, exec_batch=p, kv_pow2=16,
         catchup_rows=32, recovery_rows=32)
     t_boot = time.perf_counter()
-    sc = ShardedCluster(cfg, g, ext_rows=p)
-    _progress(f"init {time.perf_counter() - t_boot:.1f}s")
-    sc.elect(0)
-    _progress(f"elect {time.perf_counter() - t_boot:.1f}s")
+    try:
+        sc = ShardedCluster(cfg, g, ext_rows=p)
+        _progress(f"init {time.perf_counter() - t_boot:.1f}s")
+        sc.elect(0)
+        _progress(f"elect {time.perf_counter() - t_boot:.1f}s")
 
-    def block():
-        jax.block_until_ready(sc.ss.states.committed_upto)
+        def cursors():
+            upto, crt = shard_cursors(cfg, 0, sc.ss)
+            return np.asarray(upto).copy(), np.asarray(crt).copy()
 
-    # -- warmup / compile --
-    for i in range(5):
-        sc.step(p)
-        block()
-        _progress(f"warmup {i} {time.perf_counter() - t_boot:.1f}s")
+        # -- warmup / compile --
+        for i in range(5):
+            sc.step(p)
+            cursors()
+            _progress(f"warmup {i} {time.perf_counter() - t_boot:.1f}s")
 
-    # -- measured phase: continuous full-rate proposals, per-step wall
-    # times recorded for the latency estimate --
-    start_committed = [sc.committed()[0]]
-    _progress(f"committed() baseline {time.perf_counter() - t_boot:.1f}s")
-    step_wall = []
-    t0 = time.perf_counter()
-    for i in range(steps):
-        t = time.perf_counter()
-        sc.step(p)
-        block()
-        step_wall.append(time.perf_counter() - t)
-        if i % 20 == 0:
-            _progress(f"step {i} {step_wall[-1]*1e3:.1f}ms")
-    _progress(f"measured {steps} steps {time.perf_counter() - t_boot:.1f}s")
-    for _ in range(4):  # drain in-flight
-        sc.step(0)
-        block()
-    elapsed = time.perf_counter() - t0
-    committed = sc.committed()[0] - start_committed[0]
-    throughput = committed / elapsed
+        # -- measured phase: continuous full-rate proposals; per-step
+        # cursor snapshots give exact per-slot inject/commit steps --
+        upto0, crt0 = cursors()
+        start_committed = int((upto0 + 1).sum())
+        uptos, crts, walls = [upto0], [crt0], [time.perf_counter()]
+        t0 = walls[0]
+        for i in range(steps):
+            sc.step(p)
+            u, c = cursors()  # device sync == block per step
+            uptos.append(u)
+            crts.append(c)
+            walls.append(time.perf_counter())
+            if i % 20 == 0:
+                _progress(f"step {i} {(walls[-1] - walls[-2]) * 1e3:.1f}ms")
+        _progress(f"measured {steps} steps {time.perf_counter() - t_boot:.1f}s")
+        for _ in range(4):  # drain in-flight
+            sc.step(0)
+            u, c = cursors()
+            uptos.append(u)
+            crts.append(c)
+            walls.append(time.perf_counter())
+        elapsed = walls[1 + steps] - t0
+        committed = int((uptos[1 + steps] + 1).sum()) - start_committed
+        throughput = committed / elapsed
 
-    # p50 quorum decision: a slot proposed in step t is accepted by
-    # followers in t+1 (their replies carry the votes) and committed by
-    # the leader's scan in t+2 — measured commit frontiers confirm the
-    # 2-step pipeline at steady state. Decision latency = 2 steps.
-    p50 = 2.0 * float(np.median(step_wall)) * 1e3
+        # -- measured p50/p99 quorum-decision latency --
+        # slot s of shard sh: injected during step t_in with
+        # crts[t_in-1] <= s < crts[t_in]  (client hands it over at
+        # walls[t_in-1]); committed during step t_c with
+        # uptos[t_c-1] < s <= uptos[t_c]  (decision visible at
+        # walls[t_c]). Latency = walls[t_c] - walls[t_in - 1].
+        U = np.stack(uptos)  # [T+1, G]
+        C = np.stack(crts)
+        wall = np.asarray(walls)
+        lats = []
+        for sh in range(g):
+            first = int(C[0, sh])  # slots assigned before measurement
+            last_committed = int(U[-1, sh])
+            slots = np.arange(first, last_committed + 1)
+            if len(slots) == 0:
+                continue
+            # searchsorted over per-step cursor histories
+            t_in = np.searchsorted(C[:, sh], slots, side="right")
+            t_c = np.searchsorted(U[:, sh], slots, side="left")
+            ok = (t_in >= 1) & (t_in < len(wall)) & (t_c < len(wall))
+            lats.append(wall[t_c[ok]] - wall[t_in[ok] - 1])
+        if lats:
+            lat = np.concatenate(lats) * 1e3
+            p50 = float(np.percentile(lat, 50))
+            p99 = float(np.percentile(lat, 99))
+            n_lat = int(lat.size)
+        else:
+            p50 = p99 = float("nan")
+            n_lat = 0
 
-    result = {
-        "metric": "committed_instances_per_sec",
-        "value": round(throughput, 1),
-        "unit": "instances/sec",
-        "vs_baseline": round(throughput / NORTH_STAR_PER_CHIP, 4),
-        "p50_quorum_decision_ms": round(p50, 3),
-        "concurrent_instances": g * w,
-        "committed_total": committed,
-        "n_replicas": cfg.n_replicas,
-        "n_shards": g,
-        "platform": platform,
-        "baseline": "north-star 12.5e6 inst/s/chip (1M concurrent, <10ms p50, v5e-8/8); reference publishes none (BASELINE.md)",
-    }
-    print(json.dumps(result))
+        result = {
+            "metric": "committed_instances_per_sec",
+            "value": round(throughput, 1),
+            "unit": "instances/sec",
+            "vs_baseline": round(throughput / NORTH_STAR_PER_CHIP, 4),
+            "p50_quorum_decision_ms": round(p50, 3),
+            "p99_quorum_decision_ms": round(p99, 3),
+            "latency_samples": n_lat,
+            "concurrent_instances": g * w,
+            "committed_total": committed,
+            "n_replicas": cfg.n_replicas,
+            "n_shards": g,
+            "platform": platform,
+            "baseline": ("north-star 12.5e6 inst/s/chip (1M concurrent, "
+                         "<10ms p50, v5e-8/8); reference publishes none "
+                         "(BASELINE.md)"),
+        }
+        _emit(result)
+    except Exception as e:  # structured record, never a bare traceback
+        import traceback
+
+        _progress(traceback.format_exc())
+        _failure("run", repr(e))
+        sys.exit(0)
 
 
 if __name__ == "__main__":

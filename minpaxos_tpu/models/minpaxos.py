@@ -167,6 +167,13 @@ class ReplicaState(NamedTuple):
     peer_commits: jnp.ndarray  # i32[R]
     tick: jnp.ndarray  # i32 step counter (round-robin catch-up target)
     stall_ticks: jnp.ndarray  # i32 consecutive steps the frontier stalled
+    # new-leader value discovery (per-instance phase 1): which replicas
+    # answered PREPARE_INST for each slot at the CURRENT ballot. A gap
+    # slot may be no-op filled ONLY once a majority has answered "no
+    # value" — the safety condition the reference approximates with its
+    # full CatchUpLog shipping (bareminpaxos.go:488-513, :912-966)
+    pvotes: jnp.ndarray  # bool[S, R]
+    rec_cursor: jnp.ndarray  # i32 next slot the leader's sweep requests
     kv: KVState
 
     @property
@@ -206,6 +213,8 @@ def init_replica(cfg: MinPaxosConfig, me: int) -> ReplicaState:
         peer_commits=jnp.full(r, -1, dtype=jnp.int32),
         tick=jnp.int32(0),
         stall_ticks=jnp.int32(0),
+        pvotes=jnp.zeros((s, r), dtype=bool),
+        rec_cursor=jnp.int32(0),
         kv=kv_init(cfg.kv_pow2),
     )
 
@@ -231,6 +240,10 @@ def become_leader(cfg: MinPaxosConfig, state: ReplicaState) -> tuple[ReplicaStat
         leader_id=state.me.copy(),
         prepared=jnp.asarray(False),
         prepare_oks=jnp.zeros(cfg.n_replicas, dtype=bool).at[state.me].set(True),
+        # fresh ballot -> stale phase-1 answers must not count; restart
+        # the per-instance discovery sweep at our commit frontier
+        pvotes=jnp.zeros((cfg.window, cfg.n_replicas), dtype=bool),
+        rec_cursor=state.committed_upto + 1,
     )
     out = MsgBatch.empty(1)
     out = out._replace(
@@ -307,53 +320,28 @@ def replica_step_impl(
     )
     dst = jnp.where(is_prep, inbox.src, dst)
 
-    # ---- 1b. recovery suffix (PrepareReply.CatchUpLog + in-flight
-    # instance, minpaxosproto.go:56-64) ----
-    # On adopting a new leader's ballot, ship our ACCEPTED/COMMITTED
-    # slots beyond the leader's committed frontier as
-    # PREPARE_INST_REPLY rows (ballot = the slot's vballot,
-    # last_committed = the adopted prepare ballot as a context tag).
-    # Bounded at cfg.recovery_rows: like the reference, recovery
-    # assumes the in-flight window fits one reply (the runtime layers
-    # deliver outboxes reliably; see module docstring).
-    K2 = cfg.recovery_rows
-    prep_lc = inbox.last_committed[
-        jnp.argmax(jnp.where(is_prep, inbox.ballot, NO_BALLOT))]
-    rec_slots = prep_lc + 1 + jnp.arange(K2, dtype=jnp.int32)
-    rec_rel = rec_slots - state.window_base
-    rec_rel_safe = jnp.clip(rec_rel, 0, S - 1)
-    rec_ok = (
-        adopt
-        & (rec_slots < state.crt_inst)
-        & (rec_rel >= 0) & (rec_rel < S)
-        & (state.status[rec_rel_safe] >= ACCEPTED)
-    )
-    rec = MsgBatch(
-        kind=jnp.where(rec_ok, int(MsgKind.PREPARE_INST_REPLY), 0).astype(jnp.int32),
-        src=jnp.full(K2, state.me, jnp.int32),
-        ballot=state.ballot[rec_rel_safe],
-        inst=rec_slots,
-        last_committed=jnp.full(K2, state.default_ballot, jnp.int32),
-        op=state.op[rec_rel_safe],
-        key_hi=state.key_hi[rec_rel_safe],
-        key_lo=state.key_lo[rec_rel_safe],
-        val_hi=state.val_hi[rec_rel_safe],
-        val_lo=state.val_lo[rec_rel_safe],
-        cmd_id=state.cmd_id[rec_rel_safe],
-        client_id=state.client_id[rec_rel_safe],
-    )
-
-    # ---- 1c. PREPARE_INST_REPLY adoption (new leader learns peers'
-    # uncommitted values — handlePrepareReply's log-suffix merge,
-    # bareminpaxos.go:934-947) ----
+    # ---- 1c. PREPARE_INST_REPLY: phase-1 answers for the leader's
+    # per-instance discovery sweep (see 1e/7e). Two effects:
+    # * value adoption — the highest-vballot reported value is adopted
+    #   (handlePrepareReply's log-suffix merge, bareminpaxos.go:934-947,
+    #   and classic paxos.go:577-612 semantics);
+    # * pvotes — EVERY current-ballot answer (value or "empty") counts
+    #   toward the majority that gates no-op gap fill (7d). ----
     is_pir = k == int(MsgKind.PREPARE_INST_REPLY)
     rel_v, in_win_v = _rel(state, inbox.inst, S)
     rel_v_safe = jnp.minimum(rel_v, S - 1)
-    pir_ok = (
+    pv_ok = (
         is_pir
         & state.is_leader
-        & (inbox.last_committed == state.default_ballot)
+        & (inbox.last_committed == state.default_ballot)  # context tag
         & in_win_v
+    )
+    state = state._replace(
+        pvotes=state.pvotes.at[
+            jnp.where(pv_ok, rel_v, S), jnp.clip(inbox.src, 0, R - 1)
+        ].set(True, mode="drop"))
+    pir_ok = (
+        pv_ok
         & (state.status[rel_v_safe] < COMMITTED)
         & (inbox.ballot > state.ballot[rel_v_safe])
     )
@@ -453,6 +441,45 @@ def replica_step_impl(
     lc = jnp.max(jnp.where((is_accept | is_commit | is_cshort)
                            & (inbox.ballot >= state.default_ballot),
                            inbox.last_committed, -1))
+
+    # ---- 2b. PREPARE_INST (classic per-instance phase 1; the pull
+    # side of new-leader value discovery — see 7e) ----
+    # Answer ONLY truthfully: slots in our window answer with contents
+    # (vballot + value) or an explicit "empty" marker (vballot ==
+    # NO_BALLOT); slots at/beyond crt_inst are provably empty here;
+    # slots below window_base were EXECUTED and slid out — we refuse to
+    # answer (claiming "empty" for a slot we committed could let the
+    # sweep no-op fill an acked slot). The promise is the global
+    # default_ballot, already raised by steps 1-2.
+    is_pinst = k == int(MsgKind.PREPARE_INST)
+    rel_pi, in_win_pi = _rel(state, inbox.inst, S)
+    rel_pi_safe = jnp.minimum(rel_pi, S - 1)
+    pi_answer = is_pinst & (inbox.ballot >= state.default_ballot) & (
+        in_win_pi | (inbox.inst >= state.crt_inst))
+    pi_occ = pi_answer & in_win_pi & (state.status[rel_pi_safe] >= ACCEPTED)
+    out = out._replace(
+        kind=jnp.where(pi_answer, int(MsgKind.PREPARE_INST_REPLY), out.kind),
+        src=jnp.where(pi_answer, state.me, out.src),
+        inst=jnp.where(pi_answer, inbox.inst, out.inst),
+        ballot=jnp.where(pi_occ, state.ballot[rel_pi_safe],
+                         jnp.where(pi_answer, NO_BALLOT, out.ballot)),
+        last_committed=jnp.where(pi_answer, inbox.ballot, out.last_committed),
+        op=jnp.where(pi_occ, state.op[rel_pi_safe],
+                     jnp.where(pi_answer, 0, out.op)),
+        key_hi=jnp.where(pi_occ, state.key_hi[rel_pi_safe], out.key_hi),
+        key_lo=jnp.where(pi_occ, state.key_lo[rel_pi_safe], out.key_lo),
+        val_hi=jnp.where(pi_occ, state.val_hi[rel_pi_safe], out.val_hi),
+        val_lo=jnp.where(pi_occ, state.val_lo[rel_pi_safe], out.val_lo),
+        cmd_id=jnp.where(pi_occ, state.cmd_id[rel_pi_safe], out.cmd_id),
+        client_id=jnp.where(pi_occ, state.client_id[rel_pi_safe],
+                            out.client_id),
+    )
+    dst = jnp.where(pi_answer, inbox.src, dst)
+    # track the sweep's extent so a later election here starts after it
+    state = state._replace(
+        crt_inst=jnp.maximum(
+            state.crt_inst,
+            jnp.max(jnp.where(is_pinst, inbox.inst, -1)) + 1))
 
     # ---- 3. COMMIT rows (explicit per-slot commit, cold path) ----
     # A replica with no known leader (revived with an empty store into
@@ -679,7 +706,14 @@ def replica_step_impl(
     rt_rel_safe = jnp.clip(rt_rel, 0, S - 1)
     rt_in = do_rt & (rt_slots < state.crt_inst) & (rt_rel >= 0) & (rt_rel < S)
     rt_empty = rt_in & (state.status[rt_rel_safe] == NONE)
-    noop_fill = rt_empty & (state.stall_ticks >= cfg.noop_delay)
+    # A gap slot may be no-op filled ONLY when a majority (self
+    # included) answered the current-ballot per-instance phase 1 with
+    # "no value" (pvotes, fed by the 7e sweep). This is the Paxos
+    # phase-1 safety condition; the old time-based heuristic
+    # (stall_ticks >= noop_delay) could fill a slot whose committed
+    # value simply hadn't been transferred yet.
+    pv_cnt = state.pvotes[rt_rel_safe].sum(axis=1)
+    noop_fill = rt_empty & (pv_cnt >= majority)
     rt_ok = rt_in & ((state.status[rt_rel_safe] >= ACCEPTED) | noop_fill)
     # bump retried slots to the current ballot (resetting votes when
     # the ballot actually changes), so follower acks count
@@ -713,10 +747,46 @@ def replica_step_impl(
         client_id=state.client_id[rt_rel_safe],
     )
 
-    out = _concat_rows(_concat_rows(_concat_rows(_concat_rows(out, rec), fb), cu), rt)
+    # ---- 7e. per-instance phase-1 sweep (new-leader value discovery,
+    # replacing the reference's one-shot CatchUpLog shipping with a
+    # chunked, majority-audited pull: bareminpaxos.go:488-513/:912-966
+    # behavior, paxosproto Prepare{Instance} machinery) ----
+    # While leader: broadcast PREPARE_INST for the next
+    # `recovery_rows`-slot chunk of [committed_upto+1, crt_inst);
+    # followers answer via 2b; answers accumulate in pvotes (1c) and
+    # values adopt + rebroadcast via 7d. When the sweep is done but the
+    # frontier still stalls, rescan from the frontier (replies may have
+    # been lost).
+    K2 = cfg.recovery_rows
+    sweep_on = state.is_leader & state.prepared
+    done = state.rec_cursor >= state.crt_inst
+    rescan = sweep_on & done & in_flight & (
+        state.stall_ticks >= cfg.noop_delay)
+    cursor = jnp.where(rescan, state.committed_upto + 1, state.rec_cursor)
+    cursor = jnp.maximum(cursor, state.committed_upto + 1)
+    pi_slots = cursor + jnp.arange(K2, dtype=jnp.int32)
+    pi_rel = pi_slots - state.window_base
+    pi_rel_safe = jnp.clip(pi_rel, 0, S - 1)
+    pi_ok = sweep_on & (pi_slots < state.crt_inst) & (pi_rel >= 0) & (
+        pi_rel < S)
+    pi = MsgBatch.empty(K2)._replace(
+        kind=jnp.where(pi_ok, int(MsgKind.PREPARE_INST), 0).astype(jnp.int32),
+        src=jnp.full(K2, state.me, jnp.int32),
+        ballot=jnp.full(K2, state.default_ballot, jnp.int32),
+        inst=pi_slots,
+    )
+    state = state._replace(
+        # the leader answers its own phase 1 as it sweeps
+        pvotes=state.pvotes.at[
+            jnp.where(pi_ok, pi_rel, S), state.me].set(True, mode="drop"),
+        rec_cursor=jnp.where(
+            sweep_on, jnp.minimum(cursor + K2, state.crt_inst), cursor),
+    )
+
+    out = _concat_rows(_concat_rows(_concat_rows(_concat_rows(out, pi), fb), cu), rt)
     dst = jnp.concatenate([
         dst,
-        jnp.full(K2, prep_src, jnp.int32),  # recovery suffix -> new leader
+        jnp.full(K2, -1, jnp.int32),  # phase-1 sweep broadcast
         fb_dst.astype(jnp.int32),  # frontier gossip (bcast / to leader)
         jnp.full(K, peer, jnp.int32),  # catch-up -> laggard
         jnp.full(K, -1, jnp.int32),  # retry broadcast
@@ -790,6 +860,7 @@ def replica_step_impl(
             cmd_id=slide(state.cmd_id, 0),
             client_id=slide(state.client_id, 0),
             votes=slide(state.votes, False),
+            pvotes=slide(state.pvotes, False),
             window_base=state.window_base + shift,
         )
     return state, Outbox(msgs=out, dst=dst), execr

@@ -161,6 +161,17 @@ def commit_totals(cfg: MinPaxosConfig, ss: ClusterState):
     return (upto + 1).sum(), upto.min(), upto.max()
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def shard_cursors(cfg: MinPaxosConfig, leader: int, ss: ClusterState):
+    """Per-shard (committed_upto, crt_inst) at the leader replica —
+    [G] each. The bench reads these once per step to reconstruct exact
+    per-slot quorum-decision latency: slots assigned in step t are
+    crt[t-1]..crt[t]-1, and slots committed in step t are
+    upto[t-1]+1..upto[t]."""
+    return (ss.states.committed_upto[:, leader],
+            ss.states.crt_inst[:, leader])
+
+
 class ShardedCluster:
     """Host wrapper for the sharded bench/tests: boot -> elect ->
     feed device-generated proposals -> step. Mirrors models/cluster.py's

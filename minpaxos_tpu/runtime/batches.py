@@ -106,6 +106,9 @@ def frame_to_rows(buf: ColumnBuffer, kind: MsgKind, rows: np.ndarray,
         buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
                    ballot=rows["ballot"],
                    last_committed=rows["last_committed"])
+    elif kind == MsgKind.PREPARE_INST:
+        buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
+                   ballot=rows["ballot"], inst=rows["inst"])
     elif kind == MsgKind.PREPARE_REPLY:
         buf.append(n, kind=k, src=rows["id"].astype(np.int32),
                    ballot=rows["ballot"], inst=rows["crt_instance"],
@@ -179,6 +182,9 @@ def rows_to_frames(cols: dict, mask: np.ndarray) -> list[tuple[MsgKind, np.ndarr
             frame = make_batch(kind, leader_id=sub["src"][m],
                                ballot=sub["ballot"][m],
                                last_committed=sub["last_committed"][m])
+        elif kind == MsgKind.PREPARE_INST:
+            frame = make_batch(kind, leader_id=sub["src"][m],
+                               inst=sub["inst"][m], ballot=sub["ballot"][m])
         elif kind == MsgKind.PREPARE_REPLY:
             frame = make_batch(kind, id=sub["src"][m], ok=sub["op"][m],
                                ballot=sub["ballot"][m],

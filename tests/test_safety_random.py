@@ -137,6 +137,47 @@ def test_revived_replica_full_value_agreement():
     assert got == {int(k): int(k) * 7 for k in range(n)}
 
 
+def test_election_recovers_inflight_span_beyond_recovery_rows():
+    """VERDICT round-1 weak #3: a new leader must learn the ENTIRE
+    uncommitted suffix, even when the in-flight span is far larger than
+    `recovery_rows` (one sweep chunk), and must never no-op fill a slot
+    whose value survives on a majority member.
+
+    Schedule: follower 1 misses a 200-slot batch (> 6x recovery_rows);
+    the batch commits on leader 0 + follower 2; leader 0 dies; follower
+    1 — whose log is EMPTY for the whole span — is elected. Its chunked
+    PREPARE_INST sweep must pull every slot from replica 2 and
+    re-commit the original values. Reference behavior: full CatchUpLog
+    (bareminpaxos.go:488-513) + suffix adoption (:912-966)."""
+    cfg = CFG._replace(recovery_rows=32, catchup_rows=32)
+    c = Cluster(cfg, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    c.kill(1)
+    n = 200
+    c.propose(ops=[Op.PUT] * n, keys=np.arange(n), vals=np.arange(n) * 3,
+              cmd_ids=np.arange(n), client_id=7)
+    c.run(4)  # leader 0 + follower 2 accept and commit the batch
+    st0 = tree_slice(c.cs.states, 0)
+    assert int(np.asarray(st0.committed_upto)) >= n - 1, "precondition"
+    c.kill(0)
+    c.revive(1)
+    c.elect(1)
+    c.run(60)  # sweep: ~7 chunks + adoption + re-accept + commit rounds
+    for r in (1, 2):
+        st = tree_slice(c.cs.states, r)
+        assert int(np.asarray(st.committed_upto)) >= n - 1, (
+            f"replica {r} frontier stalled at "
+            f"{int(np.asarray(st.committed_upto))}")
+        snap = snapshot_committed(c, r)
+        for i in range(n):
+            op, key, val, cmd, cli = snap["entries"][i]
+            assert op == int(Op.PUT) and key == i and val == i * 3 \
+                and cmd == i and cli == 7, (
+                    f"replica {r} slot {i} lost its committed value: "
+                    f"{snap['entries'][i]} (no-op fill would show op=0)")
+
+
 def test_laggard_healed_by_new_leader_after_failover():
     """Code-review regression: replica 2 falls behind, then the ORIGINAL
     leader dies. The newly elected leader must still heal replica 2 from
