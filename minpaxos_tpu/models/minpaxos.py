@@ -418,13 +418,31 @@ def replica_step_impl(
         crt_inst=jnp.maximum(
             state.crt_inst, jnp.max(jnp.where(acc_ok, inbox.inst, -1)) + 1),
     )
+    # A re-ACCEPT of a slot we already hold COMMITTED is acked (not
+    # NACKed) iff it carries the identical decided value: commitment is
+    # final, so voting for the decided value again is always safe, and
+    # a new leader re-driving slots it learned from a partial quorum
+    # needs these votes to reach majority (second half of the
+    # elected-laggard livelock fix; value mismatch still NACKs).
+    acc_com_match = (
+        is_accept & in_win
+        & (state.status[rel_a_safe] >= COMMITTED)
+        & (state.op[rel_a_safe] == inbox.op)
+        & (state.key_hi[rel_a_safe] == inbox.key_hi)
+        & (state.key_lo[rel_a_safe] == inbox.key_lo)
+        & (state.val_hi[rel_a_safe] == inbox.val_hi)
+        & (state.val_lo[rel_a_safe] == inbox.val_lo)
+        & (state.cmd_id[rel_a_safe] == inbox.cmd_id)
+        & (state.client_id[rel_a_safe] == inbox.client_id)
+    )
     # ack every ACCEPT row (ok=0 NACK carries our promised ballot)
     out = out._replace(
         kind=jnp.where(is_accept, int(MsgKind.ACCEPT_REPLY), out.kind),
         src=jnp.where(is_accept, state.me, out.src),
         inst=jnp.where(is_accept, inbox.inst, out.inst),
         ballot=jnp.where(is_accept, state.default_ballot, out.ballot),
-        op=jnp.where(is_accept, acc_ok.astype(jnp.int32), out.op),  # op = ok flag
+        op=jnp.where(is_accept, (acc_ok | acc_com_match).astype(jnp.int32),
+                     out.op),  # op = ok flag
         last_committed=jnp.where(is_accept, state.committed_upto, out.last_committed),
     )
     dst = jnp.where(is_accept, inbox.src, dst)
@@ -456,22 +474,36 @@ def replica_step_impl(
     rel_pi_safe = jnp.minimum(rel_pi, S - 1)
     pi_answer = is_pinst & (inbox.ballot >= state.default_ballot) & (
         in_win_pi | (inbox.inst >= state.crt_inst))
-    pi_occ = pi_answer & in_win_pi & (state.status[rel_pi_safe] >= ACCEPTED)
+    # Slots we already hold COMMITTED answer with a COMMIT row instead
+    # of a phase-1 reply: this is committed-state transfer TO a behind
+    # leader — the reference's CatchUpLog-in-PrepareReply wholesale
+    # adoption (bareminpaxos.go:488-513, :912-966). Without it, an
+    # elected laggard adopts peer values as ACCEPTED, re-broadcasts
+    # ACCEPTs, and the committed peers NACK every one (acc_pre requires
+    # status < COMMITTED) — a permanent livelock at frontier -1.
+    pi_com = pi_answer & in_win_pi & (state.status[rel_pi_safe] >= COMMITTED)
+    pi_occ = (pi_answer & ~pi_com & in_win_pi
+              & (state.status[rel_pi_safe] >= ACCEPTED))
+    pi_val = pi_com | pi_occ
     out = out._replace(
-        kind=jnp.where(pi_answer, int(MsgKind.PREPARE_INST_REPLY), out.kind),
+        kind=jnp.where(pi_com, int(MsgKind.COMMIT),
+                       jnp.where(pi_answer & ~pi_com,
+                                 int(MsgKind.PREPARE_INST_REPLY), out.kind)),
         src=jnp.where(pi_answer, state.me, out.src),
         inst=jnp.where(pi_answer, inbox.inst, out.inst),
-        ballot=jnp.where(pi_occ, state.ballot[rel_pi_safe],
+        ballot=jnp.where(pi_val, state.ballot[rel_pi_safe],
                          jnp.where(pi_answer, NO_BALLOT, out.ballot)),
-        last_committed=jnp.where(pi_answer, inbox.ballot, out.last_committed),
-        op=jnp.where(pi_occ, state.op[rel_pi_safe],
+        last_committed=jnp.where(pi_com, state.committed_upto,
+                                 jnp.where(pi_answer, inbox.ballot,
+                                           out.last_committed)),
+        op=jnp.where(pi_val, state.op[rel_pi_safe],
                      jnp.where(pi_answer, 0, out.op)),
-        key_hi=jnp.where(pi_occ, state.key_hi[rel_pi_safe], out.key_hi),
-        key_lo=jnp.where(pi_occ, state.key_lo[rel_pi_safe], out.key_lo),
-        val_hi=jnp.where(pi_occ, state.val_hi[rel_pi_safe], out.val_hi),
-        val_lo=jnp.where(pi_occ, state.val_lo[rel_pi_safe], out.val_lo),
-        cmd_id=jnp.where(pi_occ, state.cmd_id[rel_pi_safe], out.cmd_id),
-        client_id=jnp.where(pi_occ, state.client_id[rel_pi_safe],
+        key_hi=jnp.where(pi_val, state.key_hi[rel_pi_safe], out.key_hi),
+        key_lo=jnp.where(pi_val, state.key_lo[rel_pi_safe], out.key_lo),
+        val_hi=jnp.where(pi_val, state.val_hi[rel_pi_safe], out.val_hi),
+        val_lo=jnp.where(pi_val, state.val_lo[rel_pi_safe], out.val_lo),
+        cmd_id=jnp.where(pi_val, state.cmd_id[rel_pi_safe], out.cmd_id),
+        client_id=jnp.where(pi_val, state.client_id[rel_pi_safe],
                             out.client_id),
     )
     dst = jnp.where(pi_answer, inbox.src, dst)
@@ -714,7 +746,20 @@ def replica_step_impl(
     # value simply hadn't been transferred yet.
     pv_cnt = state.pvotes[rt_rel_safe].sum(axis=1)
     noop_fill = rt_empty & (pv_cnt >= majority)
-    rt_ok = rt_in & ((state.status[rt_rel_safe] >= ACCEPTED) | noop_fill)
+    # A slot holding a value adopted from phase-1 answers (ballot !=
+    # default_ballot) may be re-driven at the current ballot ONLY after
+    # a majority answered the per-instance phase 1: the adopted value
+    # is then the max-vballot value over a majority — the classic Paxos
+    # phase-2 precondition. Re-driving off a single early answer could
+    # push a superseded value over a committed one (the superseding
+    # higher-vballot answer lands via 1c only later). Slots already at
+    # the current ballot were driven by this leader (safe); committed
+    # slots carry the decided value (safe).
+    own_ballot = state.ballot[rt_rel_safe] == state.default_ballot
+    settled = (pv_cnt >= majority) | (state.status[rt_rel_safe] >= COMMITTED)
+    rt_ok = rt_in & (
+        ((state.status[rt_rel_safe] >= ACCEPTED) & (own_ballot | settled))
+        | noop_fill)
     # bump retried slots to the current ballot (resetting votes when
     # the ballot actually changes), so follower acks count
     bump = rt_ok & (state.ballot[rt_rel_safe] != state.default_ballot)

@@ -85,8 +85,7 @@ def frame_to_rows(buf: ColumnBuffer, kind: MsgKind, rows: np.ndarray,
         v_hi, v_lo = split_i64(rows["val"])
         buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
                    ballot=rows["ballot"], inst=rows["inst"],
-                   last_committed=(rows["last_committed"]
-                                   if kind == MsgKind.ACCEPT else 0),
+                   last_committed=rows["last_committed"],
                    op=rows["op"].astype(np.int32),
                    key_hi=k_hi, key_lo=k_lo, val_hi=v_hi, val_lo=v_lo,
                    cmd_id=rows["cmd_id"], client_id=rows["client_id"])
@@ -165,8 +164,7 @@ def rows_to_frames(cols: dict, mask: np.ndarray) -> list[tuple[MsgKind, np.ndarr
                 op=sub["op"][m], key=join_i64(sub["key_hi"][m], sub["key_lo"][m]),
                 val=join_i64(sub["val_hi"][m], sub["val_lo"][m]),
                 cmd_id=sub["cmd_id"][m], client_id=sub["client_id"][m],
-                **({"last_committed": sub["last_committed"][m]}
-                   if kind == MsgKind.ACCEPT else {}))
+                last_committed=sub["last_committed"][m])
         elif kind == MsgKind.ACCEPT_REPLY:
             inst, ball, ok = sub["inst"][m], sub["ballot"][m], sub["op"][m]
             lc, src = sub["last_committed"][m], sub["src"][m]

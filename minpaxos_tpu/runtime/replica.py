@@ -182,8 +182,7 @@ class ReplicaServer:
             buf.append(len(rec[sl]), kind=int(kind),
                        src=rec["ballot"][sl] % 16, ballot=rec["ballot"][sl],
                        inst=rec["inst"][sl],
-                       last_committed=self.store.frontier
-                       if kind == MsgKind.ACCEPT else 0,
+                       last_committed=self.store.frontier,
                        op=rec["op"][sl].astype(np.int32),
                        key_hi=k_hi[sl], key_lo=k_lo[sl],
                        val_hi=v_hi[sl], val_lo=v_lo[sl],
@@ -418,6 +417,26 @@ class ReplicaServer:
         recs = []
         if ok_acc.any() or com.any():
             m = ok_acc | com
+            # dedup persists of already-committed slots: a heal sweep
+            # delivers R-1 copies of every slot (each peer answers
+            # PREPARE_INST with the same COMMIT row, often all in one
+            # tick's inbox), and re-ACCEPTs of committed slots re-ack;
+            # commitment is final, so re-appending only amplifies log
+            # growth + fsync volume. Drop (a) rows the store already
+            # holds committed (frontier or explicit record, vectorized),
+            # (b) all but the first COMMIT row per inst in this batch.
+            idx = np.nonzero(m)[0]
+            dup = self.store.is_committed(in_cols["inst"][:n][idx])
+            m[idx[dup]] = False
+            com = com & m
+            cidx = np.nonzero(com)[0]
+            if len(cidx) > 1:
+                _, first = np.unique(in_cols["inst"][:n][cidx],
+                                     return_index=True)
+                drop = np.ones(len(cidx), bool)
+                drop[first] = False
+                m[cidx[drop]] = False
+                com = com & m
             recs.append((in_cols["inst"][:n][m], in_cols["ballot"][:n][m],
                          np.where(com[m], COMMITTED, ACCEPTED),
                          in_cols["op"][:n][m],
@@ -432,11 +451,15 @@ class ReplicaServer:
                          join_i64(out_cols["key_hi"][:n][m], out_cols["key_lo"][:n][m]),
                          join_i64(out_cols["val_hi"][:n][m], out_cols["val_lo"][:n][m]),
                          out_cols["cmd_id"][:n][m], out_cols["client_id"][:n][m]))
-        # appended tail segments (recovery/frontier/catchup/retry rows)
-        tk = out_cols["kind"][n if n else 0:]
-        tail_acc = tk == int(MsgKind.ACCEPT)
+        # appended tail segments (recovery/frontier/catchup/retry rows).
+        # Catch-up rows (7c) re-ship slots this leader already holds
+        # committed-durable — skip re-appending those (same dedup as
+        # above, leader-side); retry rows for uncommitted slots still
+        # persist.
+        t = slice(n, None)
+        tail_acc = (out_cols["kind"][t] == int(MsgKind.ACCEPT)) \
+            & ~self.store.is_committed(out_cols["inst"][t])
         if tail_acc.any():
-            t = slice(n, None)
             m = tail_acc
             recs.append((out_cols["inst"][t][m], out_cols["ballot"][t][m],
                          np.full(m.sum(), ACCEPTED),
@@ -560,5 +583,6 @@ class ReplicaServer:
                 MsgKind.COMMIT, leader_id=self.me, inst=rec["inst"],
                 ballot=rec["ballot"], op=rec["op"], key=rec["key"],
                 val=rec["val"], cmd_id=rec["cmd_id"],
-                client_id=rec["client_id"])
+                client_id=rec["client_id"],
+                last_committed=int(np.asarray(self.state.committed_upto)))
             self._send_or_redial(q, MsgKind.COMMIT, frame)

@@ -28,6 +28,8 @@ import numpy as np
 
 MAGIC = b"MPXL0001"
 
+_COMMITTED = 4  # models/minpaxos.py status enum (kept import-free here)
+
 # one record per accepted slot
 SLOT_DT = np.dtype([
     ("inst", "<i4"), ("ballot", "<i4"), ("status", "u1"), ("op", "u1"),
@@ -53,6 +55,16 @@ class StableStore:
         self.sync = sync
         existed = os.path.exists(path) and os.path.getsize(path) > len(MAGIC)
         self.slots: dict[int, np.void] = {}
+        # insts recorded with status >= COMMITTED: commitment is final,
+        # so re-appends of these slots are pure log amplification and
+        # the runtime's _persist drops them (heal sweeps deliver R-1
+        # duplicate COMMIT rows per slot)
+        self.committed: set[int] = set()
+        self._committed_arr: np.ndarray | None = None  # sorted cache
+        # largest c with slot records 0..c all present — maintained
+        # incrementally so committed_prefix()/is_committed() never walk
+        # or sort the whole mirror
+        self._contig = -1
         self.frontier = -1
         if existed:
             self._replay()
@@ -85,6 +97,11 @@ class StableStore:
             old = self.slots.get(i)
             if old is None or int(r["ballot"]) >= int(old["ballot"]):
                 self.slots[i] = r.copy()
+            if int(r["status"]) >= _COMMITTED:
+                self.committed.add(i)
+                self._committed_arr = None
+        while (self._contig + 1) in self.slots:
+            self._contig += 1
 
     def append_frontier(self, committed_upto: int) -> None:
         if committed_upto <= self.frontier:
@@ -92,6 +109,15 @@ class StableStore:
         self.frontier = committed_upto
         self._f.write(_HDR.pack(REC_FRONTIER, _FRONTIER.size))
         self._f.write(_FRONTIER.pack(committed_upto))
+        # entries at/below min(contig, frontier) are covered by the
+        # is_committed() prefix check — prune so the set stays small in
+        # steady state instead of growing for the process lifetime
+        if self.committed:
+            covered = min(self._contig, self.frontier)
+            pruned = {i for i in self.committed if i > covered}
+            if len(pruned) != len(self.committed):
+                self.committed = pruned
+                self._committed_arr = None
 
     def flush(self) -> None:
         self._f.flush()
@@ -125,17 +151,41 @@ class StableStore:
                     old = self.slots.get(i)
                     if old is None or int(r["ballot"]) >= int(old["ballot"]):
                         self.slots[i] = r.copy()
+                    if int(r["status"]) >= _COMMITTED:
+                        self.committed.add(i)
             elif rtype == REC_FRONTIER and plen == _FRONTIER.size:
                 (fr,) = _FRONTIER.unpack_from(data, pos)
                 self.frontier = max(self.frontier, fr)
             pos += plen
+        while (self._contig + 1) in self.slots:
+            self._contig += 1
+        covered = min(self._contig, self.frontier)
+        self.committed = {i for i in self.committed if i > covered}
+
+    def is_committed(self, insts: np.ndarray) -> np.ndarray:
+        """Vectorized: True where inst is already durably committed AND
+        its record is present — at/below min(contiguous-records,
+        frontier), or an explicit COMMITTED slot record. Slots below
+        the frontier whose record is MISSING (torn write) report False
+        so peers' re-sends self-heal the hole. Used by the runtime's
+        _persist dedup; no per-row Python on the protocol thread."""
+        insts = np.asarray(insts)
+        out = insts <= min(self._contig, self.frontier)
+        if self.committed:
+            if (self._committed_arr is None
+                    or len(self._committed_arr) != len(self.committed)):
+                self._committed_arr = np.fromiter(
+                    self.committed, np.int64, len(self.committed))
+                self._committed_arr.sort()
+            arr = self._committed_arr
+            pos = np.searchsorted(arr, insts)
+            pos_c = np.minimum(pos, len(arr) - 1)
+            out = out | ((pos < len(arr)) & (arr[pos_c] == insts))
+        return out
 
     def committed_prefix(self) -> int:
         """Largest f <= logged frontier with slots 0..f all present."""
-        f = -1
-        while f < self.frontier and (f + 1) in self.slots:
-            f += 1
-        return f
+        return min(self._contig, self.frontier)
 
     def read_range(self, lo: int, hi: int) -> np.ndarray:
         """Slot records for inst in [lo, hi] that exist, ascending —

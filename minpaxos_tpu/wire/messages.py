@@ -138,9 +138,16 @@ SCHEMAS: dict[MsgKind, np.dtype] = {
         [("id", "i1"), ("ok", "u1"), ("inst", "<i4"), ("count", "<i4"),
          ("ballot", "<i4"), ("last_committed", "<i4")]),
     # Commit (with command rows) / CommitShort (range only) —
-    # minpaxosproto.go:82-94.
+    # minpaxosproto.go:82-94. last_committed piggybacks the sender's
+    # commit frontier honestly (the host catch-up path claims its real
+    # frontier; without the field, inbound COMMIT rows fabricated a
+    # frontier-0 claim). Note a just-elected leader's lc gate
+    # (models/minpaxos.py, ballot >= default_ballot) ignores claims at
+    # old ballots — COMMIT answers to its PREPARE_INST sweep heal via
+    # the direct COMMITTED install in step 3, not via this field.
     MsgKind.COMMIT: np.dtype(
-        [("leader_id", "i1"), ("inst", "<i4"), ("ballot", "<i4")] + _CMD_FIELDS),
+        [("leader_id", "i1"), ("inst", "<i4"), ("ballot", "<i4"),
+         ("last_committed", "<i4")] + _CMD_FIELDS),
     MsgKind.COMMIT_SHORT: np.dtype(
         [("leader_id", "i1"), ("inst", "<i4"), ("count", "<i4"),
          ("ballot", "<i4")]),

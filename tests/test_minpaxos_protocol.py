@@ -139,3 +139,66 @@ def test_dead_replica_stalls_then_recovers():
     c.run(4)
     st2 = tree_slice(c.cs.states, 2)
     assert int(np.asarray(st2.committed_upto)) >= 0
+
+
+def test_adopted_value_not_redriven_before_phase1_majority():
+    """Safety regression (round-3 review): a new leader that adopted a
+    slot value from a SINGLE phase-1 answer must not re-drive it until
+    a per-slot majority has answered — an early re-drive could push a
+    superseded value over one committed under a higher ballot
+    (classic Paxos phase-2 precondition). Drives replica_step_impl
+    directly to stage the async race pod-mode routing can't produce."""
+    import jax
+    import jax.numpy as jnp
+
+    from minpaxos_tpu.models.minpaxos import (
+        ACCEPTED, MsgBatch, become_leader, init_replica, replica_step_impl)
+    from minpaxos_tpu.wire.messages import MsgKind
+
+    cfg = MinPaxosConfig(n_replicas=5, window=64, inbox=64, exec_batch=16,
+                         kv_pow2=8, catchup_rows=8, recovery_rows=8)
+    st = init_replica(cfg, me=1)
+    st, _ = become_leader(cfg, st)
+    bal = int(np.asarray(st.default_ballot))
+    # prepare majority so the leader serves; a 3-slot in-flight span
+    st = st._replace(
+        prepared=jnp.asarray(True),
+        prepare_oks=jnp.ones(5, dtype=bool),
+        crt_inst=jnp.int32(3),
+    )
+    # one early phase-1 answer from replica 0 reporting v_old at an
+    # old ballot for slot 0 (context tag = current ballot)
+    pir = MsgBatch.empty(cfg.inbox)
+    pir = pir._replace(
+        kind=pir.kind.at[0].set(int(MsgKind.PREPARE_INST_REPLY)),
+        src=pir.src.at[0].set(0),
+        inst=pir.inst.at[0].set(0),
+        ballot=pir.ballot.at[0].set(2 * 16 + 0),  # v_old's low ballot
+        last_committed=pir.last_committed.at[0].set(bal),
+        op=pir.op.at[0].set(int(Op.PUT)),
+        key_lo=pir.key_lo.at[0].set(11),
+        val_lo=pir.val_lo.at[0].set(99),
+    )
+    st, out, _ = replica_step_impl(cfg, st, pir)
+    assert int(np.asarray(st.status)[0]) == ACCEPTED  # adopted
+    # stall a few steps: only 2/5 answered (self + replica 0) -> the
+    # retry path must NOT broadcast an ACCEPT for slot 0 yet
+    for _ in range(4):
+        st, out, _ = replica_step_impl(cfg, st, MsgBatch.empty(cfg.inbox))
+        acc = (np.asarray(out.msgs.kind) == int(MsgKind.ACCEPT)) & (
+            np.asarray(out.msgs.inst) == 0)
+        assert not acc.any(), "re-drove adopted value before majority"
+    # two more answers (replicas 2, 3 report empty) -> majority of 5
+    pir2 = MsgBatch.empty(cfg.inbox)
+    pir2 = pir2._replace(
+        kind=pir2.kind.at[:2].set(int(MsgKind.PREPARE_INST_REPLY)),
+        src=pir2.src.at[0].set(2).at[1].set(3),
+        inst=pir2.inst.at[:2].set(0),
+        ballot=pir2.ballot.at[:2].set(-1),  # empty answers
+        last_committed=pir2.last_committed.at[:2].set(bal),
+    )
+    st, out, _ = replica_step_impl(cfg, st, pir2)
+    st, out, _ = replica_step_impl(cfg, st, MsgBatch.empty(cfg.inbox))
+    acc = (np.asarray(out.msgs.kind) == int(MsgKind.ACCEPT)) & (
+        np.asarray(out.msgs.inst) == 0) & (np.asarray(out.msgs.ballot) == bal)
+    assert acc.any(), "majority reached but adopted value never re-driven"
