@@ -25,6 +25,10 @@ def main(argv=None) -> None:
     p.add_argument("-mport", type=int, default=7087, help="master port")
     p.add_argument("-min", action="store_true", default=True,
                    help="use MinPaxos (global-ballot Multi-Paxos)")
+    p.add_argument("-classic", action="store_true",
+                   help="use classic per-instance Multi-Paxos (explicit "
+                        "Commit/CommitShort, per-instance ballots — "
+                        "models/paxos.py; overrides -min)")
     p.add_argument("-exec", dest="exec_", action="store_true", default=True,
                    help="execute committed commands")
     p.add_argument("-dreply", action="store_true", default=True,
@@ -64,7 +68,8 @@ def main(argv=None) -> None:
     cfg = MinPaxosConfig(
         n_replicas=len(nodes), window=args.window, inbox=args.inbox,
         exec_batch=args.inbox, kv_pow2=16,
-        catchup_rows=256, recovery_rows=256)
+        catchup_rows=256, recovery_rows=256,
+        explicit_commit=args.classic)
     flags = RuntimeFlags(exec_=args.exec_, dreply=args.dreply,
                          durable=args.durable, thrifty=args.thrifty,
                          beacon=args.beacon, store_dir=args.storedir)

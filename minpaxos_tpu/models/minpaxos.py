@@ -79,6 +79,15 @@ class MinPaxosConfig(NamedTuple):
     # Size retention to cover the longest expected outage.
     slide_window: bool = True
     retention: int = -1  # executed slots retained per replica; -1 = window//2
+    # Protocol selector: False = MinPaxos (global ballot, commits learned
+    # from the LastCommitted piggyback on Accepts — bareminpaxos.go hot
+    # path, SURVEY.md 3.2); True = classic per-instance Multi-Paxos
+    # (models/paxos.py): followers commit ONLY on explicit
+    # Commit/CommitShort broadcasts (paxos.go:336-386, :522-575) and the
+    # leader commits at each instance's own ballot (per-instance
+    # bookkeeping, paxos.go:57-70). Static, so XLA specializes the
+    # kernel per protocol.
+    explicit_commit: bool = False
 
     @property
     def majority(self) -> int:
@@ -456,7 +465,14 @@ def replica_step_impl(
     # ordering; with batched mixed-kind inboxes it must be explicit).
     # COMMIT_SHORT rows carry the frontier in last_committed (the
     # leader's explicit frontier broadcast, see step 9).
-    lc = jnp.max(jnp.where((is_accept | is_commit | is_cshort)
+    # Classic mode (explicit_commit): the ACCEPT piggyback is NOT a
+    # commit signal — followers learn commitment only from explicit
+    # Commit/CommitShort (paxos.go:522-575); MinPaxos's defining trick
+    # (bareminpaxos's LastCommitted-on-Accept) is exactly what classic
+    # paxos doesn't do.
+    committish = ((is_commit | is_cshort) if cfg.explicit_commit
+                  else (is_accept | is_commit | is_cshort))
+    lc = jnp.max(jnp.where(committish
                            & (inbox.ballot >= state.default_ballot),
                            inbox.last_committed, -1))
 
@@ -638,8 +654,16 @@ def replica_step_impl(
     # ---- 7. commit scan ----
     idx_abs = state.window_base + jnp.arange(S, dtype=jnp.int32)
     n_votes = state.votes.sum(axis=1)
-    leader_commit = state.is_leader & (state.status == ACCEPTED) & (
-        n_votes >= majority) & (state.ballot == state.default_ballot)
+    if cfg.explicit_commit:
+        # classic: each instance commits at its OWN ballot (votes are
+        # reset whenever a slot's ballot changes, so n_votes counts
+        # acks for exactly the (slot, ballot) pair — per-instance
+        # bookkeeping, paxos.go:57-70, :631-660)
+        leader_commit = state.is_leader & (state.status == ACCEPTED) & (
+            n_votes >= majority)
+    else:
+        leader_commit = state.is_leader & (state.status == ACCEPTED) & (
+            n_votes >= majority) & (state.ballot == state.default_ballot)
     follower_commit = (state.status == ACCEPTED) & (idx_abs <= lc) & (
         state.ballot == state.default_ballot)
     state = state._replace(
@@ -672,7 +696,15 @@ def replica_step_impl(
         stall_ticks=jnp.where(
             state.is_leader & state.prepared & in_flight & ~advanced,
             state.stall_ticks + 1, 0))
-    lead_adv = state.is_leader & advanced
+    # classic mode broadcasts the frontier EVERY step (one row): with
+    # the Accept piggyback inert, an idle leader's followers would
+    # otherwise never learn the last commits (the reference instead
+    # bcasts per-instance Commits inline, paxos.go:661).
+    if cfg.explicit_commit:
+        lead_adv = state.is_leader & state.prepared & (
+            state.committed_upto >= 0)
+    else:
+        lead_adv = state.is_leader & advanced
     got_committy = (is_accept | is_commit | is_cshort | is_pir).any()
     fol_report = (~state.is_leader) & (state.leader_id >= 0) & (
         advanced | got_committy)
@@ -695,13 +727,24 @@ def replica_step_impl(
                        jnp.clip(state.leader_id, 0, R - 1))[None]
 
     # ---- 7c. catch-up (CatchUpLog, bareminpaxos.go:488-513) ----
-    # One peer per step, round-robin: if its known frontier trails
-    # ours, append up to `catchup_rows` committed slots as ACCEPT rows
-    # at the current ballot. A revived replica is healed within
-    # O(gap / catchup_rows * R) steps; the piggybacked frontier commits
-    # the rows on arrival.
+    # One peer per step: if its known frontier trails ours, append up
+    # to `catchup_rows` committed slots as ACCEPT rows at the current
+    # ballot; the piggybacked frontier commits them on arrival. Peer
+    # choice alternates between the MOST-lagging peer (so a revived
+    # replica heals at catchup_rows/2 per round instead of
+    # catchup_rows/R — the difference between healing under load and
+    # never catching up) and round-robin (so one permanently dead peer,
+    # whose frontier report never arrives, cannot starve a second
+    # laggard).
     K = cfg.catchup_rows
-    peer = jnp.mod(state.tick, R)
+    pc_masked = jnp.where(jnp.arange(R) == state.me, jnp.int32(2 ** 30),
+                          state.peer_commits)
+    worst = jnp.argmin(pc_masked).astype(jnp.int32)
+    # tick//2 so the round-robin half cycles ALL residues: tick % R on
+    # odd ticks only visits odd residues when R is even, which would
+    # starve even-indexed laggards whenever a dead peer pins `worst`
+    rr = jnp.mod(state.tick // 2, R)
+    peer = jnp.where(jnp.mod(state.tick, 2) == 0, worst, rr)
     lagging = state.peer_commits[peer] < state.committed_upto
     do_cu = state.is_leader & state.prepared & (peer != state.me) & lagging
     cu_slots = state.peer_commits[peer] + 1 + jnp.arange(K, dtype=jnp.int32)

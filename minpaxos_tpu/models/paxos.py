@@ -1,0 +1,61 @@
+"""Classic per-instance Multi-Paxos — the second runnable protocol.
+
+Counterpart of reference src/paxos/paxos.go (706 LoC), which the
+reference compiled but never wired into its server binary
+(server.go:58-79). Same quorum kernel family as MinPaxos
+(models/minpaxos.py), specialized by the static
+``MinPaxosConfig.explicit_commit`` flag; XLA compiles a distinct
+program per protocol. What changes, mapped to the reference:
+
+* **Explicit Commit/CommitShort** (paxos.go:336-386, handleCommit
+  :522-575, bcastCommit from handleAcceptReply :661): followers commit
+  ONLY on COMMIT rows / COMMIT_SHORT frontier broadcasts. The
+  LastCommitted-on-Accept piggyback — MinPaxos's defining optimization
+  (bareminpaxos.go:488-513) — is inert here, and the leader broadcasts
+  its frontier every step while idle so followers converge.
+* **Per-instance ballots** (Instance bookkeeping paxos.go:57-70): the
+  leader's commit scan counts votes per (slot, ballot) pair with no
+  global-ballot equality gate — instances committed under different
+  ballots coexist in the log, as after classic leader changes.
+* **ToInfinity first round + phase-1 elision** (paxos.go:421-442,
+  :465-467): ``become_leader``'s single PREPARE is exactly the
+  ToInfinity prepare — one phase-1 round establishes ``default_ballot``
+  for every future instance, and all later proposals skip straight to
+  phase 2 (``prepared`` gates exactly like ``IsLeader &&
+  defaultBallot`` elision).
+* **Per-instance recovery** (PREPARE_INST / PREPARE_INST_REPLY,
+  paxosproto.go:16-30): the chunked per-slot phase-1 sweep + majority-
+  gated adoption in the shared kernel IS classic paxos phase 1 run per
+  instance.
+* **NACK re-queue** (paxos.go:613-628): a deposed or not-yet-prepared
+  leader answers proposals with ProposeReplyTS{FALSE, Leader} and the
+  client re-queues against the hinted leader (runtime/client.py
+  failover with stable cmd_ids). The reference re-queues into its own
+  ProposeChan; here the client owns the retry so exactly-once auditing
+  stays end-to-end.
+
+Use ``classic_config()`` to build a config, then drive the protocol
+through the same pod-mode Cluster / ShardedCluster / TCP runtime as
+MinPaxos — protocol selection is one flag there too (server CLI:
+``-classic``).
+"""
+
+from __future__ import annotations
+
+from minpaxos_tpu.models.minpaxos import (
+    MinPaxosConfig,
+    ReplicaState,
+    become_leader,
+    init_replica,
+    replica_step_impl,
+)
+
+__all__ = ["classic_config", "become_leader", "init_replica",
+           "replica_step_impl", "ReplicaState", "MinPaxosConfig"]
+
+
+def classic_config(**kw) -> MinPaxosConfig:
+    """A MinPaxosConfig running classic per-instance Multi-Paxos
+    (explicit commits, per-instance commit ballots)."""
+    kw.setdefault("explicit_commit", True)
+    return MinPaxosConfig(**kw)

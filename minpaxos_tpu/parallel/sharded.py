@@ -152,6 +152,44 @@ def make_propose_ext(
     )
 
 
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=4)
+def sharded_run(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
+                k_rounds: int, ss: ClusterState, n_proposals, leader, seed0):
+    """k protocol rounds in ONE dispatch via ``lax.scan``.
+
+    The per-round host round-trip (dispatch + cursor reads) dominated
+    wall time on a remote device (BENCH_r02: seconds per round for
+    milliseconds of device compute); fusing k rounds amortizes it k-fold
+    and lets XLA pipeline the rounds. Proposals are device-generated per
+    round (make_propose_ext with seed0+t — the workload never leaves the
+    chip), and the leader's per-shard (committed_upto, crt_inst) cursors
+    are recorded per round as scan outputs, so the bench reconstructs
+    exact per-slot inject/commit rounds from ONE [k, G] transfer.
+
+    Returns (ss', uptos [k, G], crts [k, G]).
+    """
+
+    def body(ss, t):
+        ext = make_propose_ext(cfg, n_shards, ext_rows, n_proposals,
+                               leader, seed0 + t)
+        ss, _, _, _ = jax.vmap(functools.partial(cluster_step_impl, cfg))(
+            ss, ext)
+        return ss, (ss.states.committed_upto[:, leader],
+                    ss.states.crt_inst[:, leader])
+
+    ss, (uptos, crts) = jax.lax.scan(
+        body, ss, jnp.arange(k_rounds, dtype=jnp.int32))
+    return ss, uptos, crts
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
+def set_alive(cfg: MinPaxosConfig, ss: ClusterState, replica, value):
+    """Fault injection across all shards: flip one replica's alive bit
+    (the programmatic kill/revive of the reference's scripts, on
+    device)."""
+    return ss._replace(alive=ss.alive.at[:, replica].set(value))
+
+
 @functools.partial(jax.jit, static_argnums=0)
 def commit_totals(cfg: MinPaxosConfig, ss: ClusterState):
     """(total committed instances across shards at the leader-0 view,
@@ -208,3 +246,19 @@ class ShardedCluster:
     def committed(self) -> tuple[int, int, int]:
         tot, lo, hi = commit_totals(self.cfg, self.ss)
         return int(tot), int(lo), int(hi)
+
+    def run_fused(self, k_rounds: int, n_proposals: int):
+        """k rounds in one dispatch; returns per-round cursor histories
+        (numpy [k, G] committed_upto and crt_inst at the leader)."""
+        self.ss, uptos, crts = sharded_run(
+            self.cfg, self.n_shards, self.ext_rows, k_rounds, self.ss,
+            jnp.int32(min(n_proposals, self.ext_rows)),
+            jnp.int32(self.leader), jnp.int32(self._seed))
+        self._seed += k_rounds
+        return np.asarray(uptos), np.asarray(crts)
+
+    def kill(self, replica: int) -> None:
+        self.ss = set_alive(self.cfg, self.ss, jnp.int32(replica), False)
+
+    def revive(self, replica: int) -> None:
+        self.ss = set_alive(self.cfg, self.ss, jnp.int32(replica), True)

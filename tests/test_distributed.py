@@ -39,7 +39,8 @@ SMALL = dict(window=1 << 10, inbox=1024, exec_batch=512, kv_pow2=12,
 class Harness:
     """Boot master + N replicas on fresh localhost ports."""
 
-    def __init__(self, tmp_path, n=3, durable=False, thrifty=False):
+    def __init__(self, tmp_path, n=3, durable=False, thrifty=False,
+                 classic=False):
         # data ports must leave room for control ports (+1000)
         base = free_ports(1)[0]
         self.ports = free_ports(n + 1)
@@ -52,7 +53,8 @@ class Harness:
         for host, port in self.addrs:
             register_with_master(("127.0.0.1", self.mport), host, port,
                                  timeout_s=5.0)
-        self.cfg = MinPaxosConfig(n_replicas=n, **SMALL)
+        self.cfg = MinPaxosConfig(n_replicas=n, explicit_commit=classic,
+                                  **SMALL)
         self.flags = lambda: RuntimeFlags(
             durable=durable, thrifty=thrifty, store_dir=str(tmp_path),
             tick_s=0.001)
@@ -177,4 +179,17 @@ def test_thrifty_still_commits(harness):
     ops, keys, vals = gen_workload(200, seed=5)
     stats = cli.run_workload(ops, keys, vals, timeout_s=30)
     assert stats["acked"] == 200, stats
+    cli.close_conn()
+
+
+def test_classic_paxos_over_tcp(harness):
+    """Classic per-instance Multi-Paxos (server -classic) over the real
+    TCP runtime: commits flow only via explicit Commit/CommitShort
+    (paxos.go:336-386) and exactly-once holds end-to-end."""
+    h = harness(classic=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(500, seed=7)
+    stats = cli.run_workload(ops, keys, vals, timeout_s=30)
+    assert stats["acked"] == 500, stats
+    assert stats["duplicates"] == 0
     cli.close_conn()

@@ -1,0 +1,148 @@
+"""Classic per-instance Multi-Paxos (models/paxos.py) tests.
+
+The protocol's defining behaviors vs MinPaxos, from the reference:
+commits travel ONLY as explicit Commit/CommitShort (paxos.go:522-575),
+never as the Accept LastCommitted piggyback; instances commit at their
+own ballots (paxos.go:57-70); one ToInfinity phase-1 round then elision
+(paxos.go:421-442).
+"""
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.cluster import Cluster, tree_slice
+from minpaxos_tpu.models.minpaxos import (
+    ACCEPTED,
+    COMMITTED,
+    MinPaxosConfig,
+    MsgBatch,
+    init_replica,
+    replica_step_impl,
+)
+from minpaxos_tpu.models.paxos import classic_config
+from minpaxos_tpu.wire.messages import MsgKind, Op
+
+CFG = classic_config(n_replicas=3, window=256, inbox=512, exec_batch=128,
+                     kv_pow2=10)
+
+
+def _accept_rows(cfg, n, ballot, last_committed):
+    b = MsgBatch.empty(cfg.inbox)
+    return b._replace(
+        kind=b.kind.at[:n].set(int(MsgKind.ACCEPT)),
+        src=b.src.at[:n].set(0),
+        ballot=b.ballot.at[:n].set(ballot),
+        inst=b.inst.at[:n].set(np.arange(n)),
+        last_committed=b.last_committed.at[:n].set(last_committed),
+        op=b.op.at[:n].set(int(Op.PUT)),
+        key_lo=b.key_lo.at[:n].set(np.arange(n)),
+        val_lo=b.val_lo.at[:n].set(np.arange(n) * 2),
+    )
+
+
+def test_classic_follower_ignores_accept_piggyback():
+    """The piggybacked LastCommitted must NOT commit anything in
+    classic mode (it does in MinPaxos — that's the protocols' defining
+    difference); an explicit COMMIT_SHORT must."""
+    bal = 16  # ballot of leader 0
+    for explicit, expect_commit in ((True, False), (False, True)):
+        cfg = MinPaxosConfig(n_replicas=3, window=256, inbox=64,
+                             exec_batch=16, kv_pow2=8,
+                             explicit_commit=explicit)
+        st = init_replica(cfg, me=1)
+        st = st._replace(default_ballot=np.int32(bal))
+        st, _, _ = replica_step_impl(cfg, st, _accept_rows(cfg, 8, bal, 7))
+        upto = int(np.asarray(st.committed_upto))
+        if expect_commit:
+            assert upto == 7, "MinPaxos piggyback must commit"
+        else:
+            assert upto == -1, "classic follower committed from piggyback"
+            assert int(np.asarray(st.status)[0]) == ACCEPTED
+            # now the explicit frontier broadcast arrives
+            cs = MsgBatch.empty(cfg.inbox)
+            cs = cs._replace(
+                kind=cs.kind.at[0].set(int(MsgKind.COMMIT_SHORT)),
+                src=cs.src.at[0].set(0),
+                ballot=cs.ballot.at[0].set(bal),
+                last_committed=cs.last_committed.at[0].set(7),
+            )
+            st, _, _ = replica_step_impl(cfg, st, cs)
+            assert int(np.asarray(st.committed_upto)) == 7
+            assert int(np.asarray(st.status)[0]) >= COMMITTED
+
+
+def test_classic_end_to_end_commit_and_reply():
+    c = Cluster(CFG, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    c.propose(ops=[Op.PUT, Op.PUT, Op.GET], keys=[1, 2, 1],
+              vals=[10, 20, 0], cmd_ids=[0, 1, 2], client_id=7)
+    c.run(5)
+    assert c.replies[(7, 0)]["value"] == 10
+    assert c.replies[(7, 2)]["value"] == 10 and c.replies[(7, 2)]["found"]
+    # followers converged through explicit commits only
+    for r in range(3):
+        st = tree_slice(c.cs.states, r)
+        assert int(np.asarray(st.committed_upto)) == 2
+    dups = [e for e in c.reply_log if e.get("duplicate")]
+    assert not dups
+
+
+def test_classic_leader_failover():
+    c = Cluster(CFG, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    n = 40
+    c.propose(ops=[Op.PUT] * n, keys=np.arange(n), vals=np.arange(n) * 9,
+              cmd_ids=np.arange(n), client_id=3)
+    c.run(4)
+    c.kill(0)
+    c.elect(1)
+    c.run(25)
+    m = 10
+    c.propose(ops=[Op.PUT] * m, keys=np.arange(m) + 100,
+              vals=np.arange(m) + 500, cmd_ids=np.arange(m) + n,
+              client_id=3, to=1)
+    c.run(8)
+    st1 = tree_slice(c.cs.states, 1)
+    assert int(np.asarray(st1.committed_upto)) >= n + m - 1
+    # old values survived the failover (phase-1 sweep re-drove them)
+    snap_ops = np.asarray(st1.op)
+    snap_vals = np.asarray(st1.val_lo)
+    base = int(np.asarray(st1.window_base))
+    for i in range(n):
+        assert snap_vals[i - base] == i * 9, f"slot {i} lost its value"
+
+
+def test_classic_mixed_ballot_instances_commit():
+    """Per-instance ballots: after a failover, re-driven instances and
+    new instances carry different ballots, and both commit — the
+    per-instance bookkeeping classic paxos keeps (paxos.go:57-70)."""
+    c = Cluster(CFG, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    c.propose(ops=[Op.PUT] * 5, keys=np.arange(5), vals=np.arange(5),
+              cmd_ids=np.arange(5), client_id=1)
+    c.run(4)
+    c.elect(1)  # higher ballot, same membership
+    c.run(15)
+    c.propose(ops=[Op.PUT] * 5, keys=np.arange(5) + 50,
+              vals=np.arange(5) + 50, cmd_ids=np.arange(5) + 5,
+              client_id=1, to=1)
+    c.run(6)
+    st = tree_slice(c.cs.states, 1)
+    assert int(np.asarray(st.committed_upto)) >= 9
+    ballots = np.asarray(st.ballot)[:10]
+    # slots 0-4 committed under leader 0's era keep their ORIGINAL
+    # ballot (committed slots answer the sweep with COMMIT rows, never
+    # get re-driven); slots 5-9 carry leader 1's strictly higher ballot
+    # — the per-instance coexistence classic paxos allows and the
+    # global-ballot mode forbids
+    old = set(ballots[:5].tolist())
+    new = set(ballots[5:].tolist())
+    assert len(old) == 1 and len(new) == 1, (old, new)
+    assert min(new) > max(old), f"expected mixed ballots, got {ballots}"
+    # every committed slot's value is intact
+    vals = np.asarray(st.val_lo)[:10]
+    want = list(range(5)) + [50 + i for i in range(5)]
+    assert vals.tolist() == want

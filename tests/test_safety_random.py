@@ -41,10 +41,12 @@ def snapshot_committed(c: Cluster, r: int):
     return {"upto": upto, "entries": entries}
 
 
+@pytest.mark.parametrize("protocol", ["minpaxos", "classic"])
 @pytest.mark.parametrize("seed", [11, 22, 33])
-def test_random_fault_schedule_safety(seed):
+def test_random_fault_schedule_safety(seed, protocol):
     rng = np.random.default_rng(seed)
-    c = Cluster(CFG, ext_rows=256)
+    c = Cluster(CFG._replace(explicit_commit=(protocol == "classic")),
+                ext_rows=256)
     c.elect(0)
     c.run(3)
     stable: dict[int, dict[int, tuple]] = {r: {} for r in range(3)}
