@@ -179,6 +179,61 @@ def _latency_rounds(uptos, crts, round_ms):
             int(lat.size), uncommitted)
 
 
+def _side_config(cfg, g, p, k, protocol, dispatches=2):
+    """One BASELINE side config: small fused run, returns a record.
+
+    configs 2-4 (BASELINE.md): classic paxos sequential / classic paxos
+    64k concurrent / mencius 64k. Each uses the same fused runner as
+    the headline so the numbers are comparable."""
+    import numpy as np
+
+    from minpaxos_tpu.parallel.sharded import ShardedCluster, shard_cursors
+
+    sc = ShardedCluster(cfg, g, ext_rows=max(p, 1), protocol=protocol)
+    if protocol != "mencius":
+        sc.elect(0)
+    sc.run_fused(k, p)  # compile + warm
+    start = sc.committed()[0]
+    u0, c0 = shard_cursors(cfg, max(sc.leader, 0), sc.ss)
+    # pre-phase cursor row: without it round-1 injections are censored
+    U, C = [np.asarray(u0)[None].copy()], [np.asarray(c0)[None].copy()]
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        u, c = sc.run_fused(k, p)
+        U.append(u)
+        C.append(c)
+    wall = time.perf_counter() - t0
+    committed = sc.committed()[0] - start
+    rounds = dispatches * k
+    round_ms = wall / rounds * 1e3
+    # drain so the slowest (late-injected) slots enter the sample
+    drain_rounds = 0
+    for _ in range(6):
+        u, c = sc.run_fused(k, 0)
+        U.append(u)
+        C.append(c)
+        drain_rounds += k
+        if (u[-1] >= c[-1] - 1).all():
+            break
+    p50, p99, n_lat, unc = _latency_rounds(
+        np.concatenate(U), np.concatenate(C), round_ms)
+    return {
+        "protocol": protocol if protocol == "mencius" else (
+            "paxos" if cfg.explicit_commit else "minpaxos"),
+        "throughput_inst_per_sec": round(committed / wall, 1),
+        "p50_ms": round(p50, 3),
+        "p99_ms": round(p99, 3),
+        "latency_samples": n_lat,
+        "uncommitted_after_drain": unc,
+        "drain_rounds": drain_rounds,
+        "concurrent_instances": g * cfg.window,
+        "proposals_per_round": g * p * (cfg.n_replicas
+                                        if protocol == "mencius" else 1),
+        "rounds": rounds,
+        "device_ms_per_round": round(round_ms, 3),
+    }
+
+
 def main() -> None:
     devices = _init_backend()
     import jax
@@ -311,6 +366,7 @@ def main() -> None:
             "p99_quorum_decision_ms": round(p99, 3),
             "latency_samples": n_lat,
             "latency_uncommitted_after_drain": uncommitted,
+            "drain_rounds": drain_rounds,
             "concurrent_instances": g * w,
             "proposals_per_round": g * p,
             "committed_total": committed_total,
@@ -331,6 +387,62 @@ def main() -> None:
                          "<10ms p50, v5e-8/8); reference publishes none "
                          "(BASELINE.md)"),
         }
+
+        # -- BASELINE side configs 2-4 (config 1, the TCP runtime, is
+        # measured separately: bench_tcp.py writes BENCH_TCP.json) --
+        from minpaxos_tpu.models.paxos import classic_config
+
+        side_shapes = {
+            # cfg2: classic paxos, 1 client, sequential instances
+            # (1 proposal per round — pipelined-sequential)
+            "paxos_sequential": (
+                classic_config(n_replicas=5, window=1024, inbox=256,
+                               exec_batch=32, kv_pow2=12,
+                               catchup_rows=32, recovery_rows=32),
+                1, 1, 128 if on_tpu else 32, "classic"),
+            # cfg3: classic paxos, 16 clients (=16 shards), 64k
+            # concurrent instances
+            "paxos_64k": (
+                classic_config(n_replicas=5, window=4096,
+                               inbox=4 * 256 + 128, exec_batch=256,
+                               kv_pow2=14, catchup_rows=64,
+                               recovery_rows=64),
+                16, 256, 32 if on_tpu else 8, "classic"),
+            # cfg4: mencius, 5 rotating owners, 64k instances
+            # catchup_rows = the per-step COMMIT-broadcast chunk in the
+            # mencius kernel; must exceed the per-owner proposal rate
+            # (64/round) or the frontier can never drain its backlog
+            "mencius_64k": (
+                MinPaxosConfig(n_replicas=5, window=4096,
+                               inbox=2048, exec_batch=320,
+                               kv_pow2=14, catchup_rows=128,
+                               recovery_rows=64, noop_delay=8),
+                16, 64, 32 if on_tpu else 8, "mencius"),
+        }
+        # each side config runs under a watchdog: the tunnel can hang
+        # (BENCH_r01), and losing the finished headline measurements to
+        # a wedged side config would be the worst outcome. A hung
+        # worker thread is daemon — the final emit still happens.
+        def _guarded(fn, *a, timeout_s=600.0):
+            box: list = []
+            t = threading.Thread(target=lambda: box.append(fn(*a)),
+                                 daemon=True)
+            t.start()
+            t.join(timeout=timeout_s)
+            if not box:
+                raise TimeoutError(f"side config hung > {timeout_s}s")
+            return box[0]
+
+        result["configs"] = {}
+        for name, (scfg, sg, sp, sk, proto) in side_shapes.items():
+            try:
+                t0 = time.perf_counter()
+                result["configs"][name] = _guarded(
+                    _side_config, scfg, sg, sp, sk, proto)
+                _progress(f"config {name} {time.perf_counter() - t0:.0f}s")
+            except Exception as e:
+                result["configs"][name] = {"error": repr(e)[:200]}
+                _progress(f"config {name} FAILED {e!r}")
         _emit(result)
     except Exception as e:  # structured record, never a bare traceback
         import traceback

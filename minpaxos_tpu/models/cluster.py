@@ -91,7 +91,8 @@ def _route(cfg: MinPaxosConfig, out_msgs: MsgBatch, dst: jnp.ndarray,
 
 
 def cluster_step_impl(
-    cfg: MinPaxosConfig, cs: ClusterState, ext: MsgBatch
+    cfg: MinPaxosConfig, cs: ClusterState, ext: MsgBatch,
+    step_impl=replica_step_impl,
 ) -> tuple[ClusterState, "ExecResult", MsgBatch, jnp.ndarray]:
     """One synchronous round: deliver pending + ext, step all replicas,
     route the new outboxes.
@@ -99,13 +100,18 @@ def cluster_step_impl(
     ext is [R, Mext] host-injected rows (client proposes to the leader,
     PREPAREs from elections). Returns (state', exec results [R, E],
     client-bound rows [R, M_total], client-bound mask).
+
+    ``step_impl`` is the per-replica protocol step (static): MinPaxos /
+    classic paxos use replica_step_impl; Mencius passes
+    models/mencius.py's mencius_step_impl. The routing fabric is
+    protocol-agnostic — it only reads the Outbox.
     """
     inbox = _concat_rows(cs.pending, ext)
     # dead replicas see silence
     inbox = inbox._replace(
         kind=jnp.where(cs.alive[:, None], inbox.kind, 0))
     states, outbox, execr = jax.vmap(
-        functools.partial(replica_step_impl, cfg))(cs.states, inbox)
+        functools.partial(step_impl, cfg))(cs.states, inbox)
     pending = _route(cfg, outbox.msgs, outbox.dst, cs.alive, cfg.inbox)
     client_rows = outbox.msgs
     client_mask = (outbox.dst == -2) & (outbox.msgs.kind != 0)
@@ -114,7 +120,8 @@ def cluster_step_impl(
 
 # Jitted entry point for single-group (unsharded) pod mode; parallel/
 # sharded.py vmaps cluster_step_impl over a shard axis instead.
-cluster_step = jax.jit(cluster_step_impl, static_argnums=0, donate_argnums=1)
+cluster_step = jax.jit(cluster_step_impl, static_argnums=(0, 3),
+                       donate_argnums=1)
 
 
 class Cluster:

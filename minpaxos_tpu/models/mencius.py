@@ -1,0 +1,861 @@
+"""Mencius — rotating-ownership multi-leader consensus, third protocol.
+
+Counterpart of reference src/mencius/mencius.go (897 LoC; compiled but
+never wired into the reference's server binary, server.go:62-65). Core
+ideas, mapped to the reference:
+
+* **Rotating ownership** (mencius.go:99, :431-432): replica r owns log
+  slots i with i % N == r and serves proposals directly into them —
+  every replica is a leader for its own slots; there is no election.
+* **SKIP / cede** (:276-304, :449-457, delayed batching :498-501,
+  :592-599): a replica that receives an Accept for a slot ahead of its
+  own cursor cedes its intervening owned slots as committed no-ops and
+  broadcasts ONE Skip row covering the whole range — the reference's
+  delayed-skip timer batches skips across events; here a protocol step
+  IS the batch, so each step emits at most one Skip row per replica.
+* **Explicit commit broadcast** (bcastCommit :606-650): an owner that
+  reaches majority on its slot broadcasts COMMIT rows (chunked per
+  step) — peers cannot count votes (acks flow owner-only), so commits
+  must travel explicitly, like classic paxos.
+* **Blocking frontier** (updateBlocking :744-797): the executable
+  prefix advances only through slots that are committed or skipped,
+  across ALL owners' interleaved slots — here ``commit_frontier`` over
+  the merged window.
+* **forceCommit takeover** (:244-257, :878-897): when the frontier
+  stalls on a dead owner's slot, that owner's successor ((o+1) % N)
+  runs per-instance phase 1 (PREPARE_INST at a takeover ballot >
+  ballot 0 that ownership implies) over the blocked range and no-op
+  fills slots a majority reports empty — the reference's
+  NB_INST_TO_SKIP bulk skip, but majority-audited per slot (the same
+  pvotes machinery as models/minpaxos.py step 7d/7e).
+* **Conflict-aware out-of-order execution** (:799-876): committed
+  slots above the blocking frontier execute early when every earlier
+  conflicting slot (same key, >= one PUT — state.go:55-62) inside the
+  window is already committed; the sorted-segment scan that proves
+  non-conflict shares its machinery with the KV engine's
+  sequential-equivalence pass (ops/kvstore.py).
+
+Ballots: slot ownership IS ballot 0 (only the owner may propose there
+— the asymmetry that lets an owner accept its own slot without a
+prepare). Takeover ballots are make_ballot(counter, successor) > 0,
+driven through classic per-instance phase 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from minpaxos_tpu.models.minpaxos import (
+    ACCEPTED,
+    COMMITTED,
+    EXECUTED,
+    NO_BALLOT,
+    NONE,
+    ExecResult,
+    MinPaxosConfig,
+    MsgBatch,
+    Outbox,
+    _concat_rows,
+    _rel,
+    make_ballot,
+)
+from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
+from minpaxos_tpu.ops.scan import commit_frontier, segmented_scan_max
+from minpaxos_tpu.wire.messages import MsgKind, Op
+
+
+class MenciusState(NamedTuple):
+    """One Mencius replica's device state. Field names shared with
+    ReplicaState where the host wrappers read them (committed_upto,
+    executed_upto, crt_inst, window_base, kv...)."""
+
+    # log window [S]
+    ballot: jnp.ndarray  # i32: 0 = owner ballot, >0 takeover
+    status: jnp.ndarray
+    op: jnp.ndarray
+    key_hi: jnp.ndarray
+    key_lo: jnp.ndarray
+    val_hi: jnp.ndarray
+    val_lo: jnp.ndarray
+    cmd_id: jnp.ndarray
+    client_id: jnp.ndarray
+    votes: jnp.ndarray  # bool[S, R] acks for my owned slots
+    pvotes: jnp.ndarray  # bool[S, R] takeover phase-1 answers
+    executed: jnp.ndarray  # bool[S] (out-of-order exec tracking)
+    # scalars
+    me: jnp.ndarray
+    window_base: jnp.ndarray
+    crt_own: jnp.ndarray  # next owned slot to propose into (== me mod R)
+    crt_inst: jnp.ndarray  # max slot seen + 1 (any owner)
+    committed_upto: jnp.ndarray  # global blocking frontier
+    executed_upto: jnp.ndarray  # contiguous executed prefix
+    commit_sent: jnp.ndarray  # own slots <= this had commits broadcast
+    takeover_ballot: jnp.ndarray  # my current takeover ballot (or -1)
+    tk_anchor: jnp.ndarray  # first slot of my latest takeover span (-1)
+    max_recv_ballot: jnp.ndarray
+    tick: jnp.ndarray
+    stall_ticks: jnp.ndarray
+    kv: KVState
+
+
+def init_mencius(cfg: MinPaxosConfig, me: int) -> MenciusState:
+    s, r = cfg.window, cfg.n_replicas
+
+    def zi():
+        return jnp.zeros(s, dtype=jnp.int32)
+
+    return MenciusState(
+        ballot=jnp.full(s, NO_BALLOT, dtype=jnp.int32),
+        status=zi(),
+        op=zi(),
+        key_hi=zi(),
+        key_lo=zi(),
+        val_hi=zi(),
+        val_lo=zi(),
+        cmd_id=zi(),
+        client_id=zi(),
+        votes=jnp.zeros((s, r), dtype=bool),
+        pvotes=jnp.zeros((s, r), dtype=bool),
+        executed=jnp.zeros(s, dtype=bool),
+        me=jnp.int32(me),
+        window_base=jnp.int32(0),
+        crt_own=jnp.int32(me),
+        crt_inst=jnp.int32(0),
+        committed_upto=jnp.int32(-1),
+        executed_upto=jnp.int32(-1),
+        commit_sent=jnp.int32(-1),
+        takeover_ballot=jnp.int32(NO_BALLOT),
+        tk_anchor=jnp.int32(-1),
+        max_recv_ballot=jnp.int32(0),
+        tick=jnp.int32(0),
+        stall_ticks=jnp.int32(0),
+        kv=kv_init(cfg.kv_pow2),
+    )
+
+
+def mencius_step_impl(
+    cfg: MinPaxosConfig, state: MenciusState, inbox: MsgBatch
+) -> tuple[MenciusState, Outbox, ExecResult]:
+    """Advance one Mencius replica by one message batch (pure; vmapped
+    by the cluster wrapper below)."""
+    S, R = cfg.window, cfg.n_replicas
+    M = inbox.kind.shape[0]
+    majority = cfg.majority
+    me = state.me
+    k = inbox.kind
+    idx = jnp.arange(S, dtype=jnp.int32)
+    idx_abs = state.window_base + idx
+    own_mask = jnp.mod(idx_abs, R) == me
+
+    is_propose = k == int(MsgKind.PROPOSE)
+    is_accept = k == int(MsgKind.ACCEPT)
+    is_areply = k == int(MsgKind.ACCEPT_REPLY)
+    is_skip = k == int(MsgKind.SKIP)
+    is_commit = k == int(MsgKind.COMMIT)
+    is_pinst = k == int(MsgKind.PREPARE_INST)
+    is_pir = k == int(MsgKind.PREPARE_INST_REPLY)
+
+    out = MsgBatch.empty(M)
+    dst = jnp.full(M, -1, jnp.int32)
+
+    # ---- 1. PROPOSE into my owned slots (handlePropose :429-447) ----
+    prefix = jnp.cumsum(is_propose.astype(jnp.int32)) - 1
+    slots_p = state.crt_own + R * prefix
+    rel_p = slots_p - state.window_base
+    fits = is_propose & (rel_p >= 0) & (rel_p < S)
+    tgt_p = jnp.where(fits, rel_p, S)
+    self_vote = jax.nn.one_hot(me, R, dtype=bool)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_p].set(0, mode="drop"),
+        status=state.status.at[tgt_p].set(ACCEPTED, mode="drop"),
+        op=state.op.at[tgt_p].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt_p].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt_p].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt_p].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt_p].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt_p].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt_p].set(inbox.client_id, mode="drop"),
+        votes=state.votes.at[tgt_p].set(
+            jnp.broadcast_to(self_vote, (M, R)), mode="drop"),
+    )
+    n_prop = jnp.where(fits, 1, 0).sum()
+    state = state._replace(
+        crt_own=state.crt_own + R * n_prop,
+        crt_inst=jnp.maximum(state.crt_inst,
+                             state.crt_own + R * n_prop - R + 1),
+    )
+    # broadcast ACCEPT rows; rejected (window-full) proposals bounce
+    reject = is_propose & ~fits
+    out = out._replace(
+        kind=jnp.where(fits, int(MsgKind.ACCEPT),
+                       jnp.where(reject, int(MsgKind.PROPOSE_REPLY),
+                                 out.kind)),
+        src=jnp.where(is_propose, me, out.src),
+        inst=jnp.where(fits, slots_p, out.inst),
+        ballot=jnp.where(fits, 0, jnp.where(reject, me, out.ballot)),
+        op=jnp.where(fits, inbox.op, jnp.where(reject, 0, out.op)),
+        key_hi=jnp.where(is_propose, inbox.key_hi, out.key_hi),
+        key_lo=jnp.where(is_propose, inbox.key_lo, out.key_lo),
+        val_hi=jnp.where(is_propose, inbox.val_hi, out.val_hi),
+        val_lo=jnp.where(is_propose, inbox.val_lo, out.val_lo),
+        cmd_id=jnp.where(is_propose, inbox.cmd_id, out.cmd_id),
+        client_id=jnp.where(is_propose, inbox.client_id, out.client_id),
+        last_committed=jnp.where(fits, state.committed_upto,
+                                 out.last_committed),
+    )
+    dst = jnp.where(fits, -1, jnp.where(reject, -2, dst))
+
+    # ---- 2. ACCEPT from other owners (handleAccept :503-590) ----
+    rel_a, in_win_a = _rel(state, inbox.inst, S)
+    rel_a_safe = jnp.minimum(rel_a, S - 1)
+    # only the slot's owner (or a takeover ballot > current) may write
+    owner_ok = jnp.mod(inbox.inst, R) == inbox.src
+    acc_pre = (
+        is_accept & in_win_a
+        & (owner_ok | (inbox.ballot > 0))
+        & (inbox.ballot >= state.ballot[rel_a_safe])
+        & (state.status[rel_a_safe] < COMMITTED)
+    )
+    ab_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
+        jnp.where(acc_pre, rel_a, S)].max(inbox.ballot, mode="drop")
+    acc_ok = acc_pre & (inbox.ballot == ab_max[rel_a_safe])
+    tgt_a = jnp.where(acc_ok, rel_a, S)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_a].set(inbox.ballot, mode="drop"),
+        status=state.status.at[tgt_a].set(ACCEPTED, mode="drop"),
+        op=state.op.at[tgt_a].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt_a].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt_a].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt_a].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt_a].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt_a].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt_a].set(inbox.client_id, mode="drop"),
+        crt_inst=jnp.maximum(
+            state.crt_inst, jnp.max(jnp.where(acc_ok, inbox.inst, -1)) + 1),
+        max_recv_ballot=jnp.maximum(
+            state.max_recv_ballot,
+            jnp.max(jnp.where(is_accept, inbox.ballot, 0))),
+    )
+    # ack to the sender; a committed slot re-acks ONLY if the accept
+    # carries the identical decided content — an owner's stale value-
+    # ACCEPT arriving after a takeover committed a no-op here must NACK,
+    # or the owner could assemble a majority for a conflicting value
+    # (vote-for-the-decided-value rule, as in models/minpaxos.py)
+    acc_dup_ok = (
+        is_accept & in_win_a
+        & (state.status[rel_a_safe] >= COMMITTED)
+        & (state.op[rel_a_safe] == inbox.op)
+        & (state.key_hi[rel_a_safe] == inbox.key_hi)
+        & (state.key_lo[rel_a_safe] == inbox.key_lo)
+        & (state.val_hi[rel_a_safe] == inbox.val_hi)
+        & (state.val_lo[rel_a_safe] == inbox.val_lo)
+        & (state.cmd_id[rel_a_safe] == inbox.cmd_id)
+        & (state.client_id[rel_a_safe] == inbox.client_id)
+    )
+    out = out._replace(
+        kind=jnp.where(is_accept, int(MsgKind.ACCEPT_REPLY), out.kind),
+        src=jnp.where(is_accept, me, out.src),
+        inst=jnp.where(is_accept, inbox.inst, out.inst),
+        ballot=jnp.where(is_accept, inbox.ballot, out.ballot),
+        op=jnp.where(is_accept, (acc_ok | acc_dup_ok).astype(jnp.int32),
+                     out.op),
+        last_committed=jnp.where(is_accept, state.committed_upto,
+                                 out.last_committed),
+    )
+    dst = jnp.where(is_accept, inbox.src, dst)
+
+    # ---- 3. skip-cede (handleAccept's skip side, :520-556) ----
+    # Accepts for slots ahead of my cursor mean peers are running ahead
+    # of me: cede my untouched owned slots below the horizon as
+    # committed no-ops and tell everyone in ONE Skip row. (The
+    # reference batches skips with a 50ms timer + MAX_SKIPS_WAITING=20;
+    # one step = one batch here.)
+    horizon = jnp.maximum(
+        jnp.max(jnp.where(is_accept & acc_ok, inbox.inst, -1)) + 1,
+        state.committed_upto + 1)
+    cede = (own_mask & (idx_abs >= state.crt_own) & (idx_abs < horizon)
+            & (state.status == NONE))
+    any_cede = cede.any()
+    state = state._replace(
+        status=jnp.where(cede, COMMITTED, state.status),
+        ballot=jnp.where(cede, 0, state.ballot),
+        op=jnp.where(cede, int(Op.NONE), state.op),
+        cmd_id=jnp.where(cede, 0, state.cmd_id),
+        client_id=jnp.where(cede, -1, state.client_id),
+        crt_own=jnp.where(
+            any_cede,
+            # first owned slot >= horizon
+            horizon + jnp.mod(me - horizon, R),
+            state.crt_own),
+    )
+    skip_row = MsgBatch.empty(1)._replace(
+        kind=jnp.where(any_cede, int(MsgKind.SKIP), 0)[None].astype(jnp.int32),
+        src=jnp.full(1, me, jnp.int32),
+        inst=jnp.maximum(state.crt_own - R, 0)[None],  # cede end (own)
+        ballot=jnp.zeros(1, jnp.int32),
+        # last_committed carries cede start (wire start_inst)
+        last_committed=jnp.maximum(
+            jnp.min(jnp.where(cede, idx_abs, jnp.int32(2 ** 30))), 0)[None],
+    )
+
+    # ---- 4. SKIP rows from peers (handleSkip :449-501) ----
+    # Mark src's owned slots in [start, end] as committed no-ops.
+    # Safe against value loss: only the owner proposes VALUES at
+    # ballot 0, and an owner never cedes a slot it proposed into, so a
+    # skip range can only cover slots whose sole possible content is a
+    # no-op (status guard below keeps locally-known content anyway).
+    skip_src = jnp.clip(inbox.src, 0, R - 1)
+    # per-owner min start / max end across skip rows this batch
+    starts = jnp.full(R, jnp.int32(2 ** 30)).at[
+        jnp.where(is_skip, skip_src, R)].min(inbox.last_committed,
+                                             mode="drop")
+    ends = jnp.full(R, jnp.int32(-1)).at[
+        jnp.where(is_skip, skip_src, R)].max(inbox.inst, mode="drop")
+    owner_of = jnp.mod(idx_abs, R)
+    skipped = ((idx_abs >= starts[owner_of]) & (idx_abs <= ends[owner_of])
+               & (state.status < COMMITTED))
+    state = state._replace(
+        status=jnp.where(skipped, COMMITTED, state.status),
+        ballot=jnp.where(skipped, 0, state.ballot),
+        op=jnp.where(skipped, int(Op.NONE), state.op),
+        cmd_id=jnp.where(skipped, 0, state.cmd_id),
+        client_id=jnp.where(skipped, -1, state.client_id),
+        crt_inst=jnp.maximum(state.crt_inst,
+                             jnp.max(jnp.where(is_skip, inbox.inst, -1)) + 1),
+    )
+
+    # ---- 5. ACCEPT_REPLY vote counting (handleAcceptReply :692-742) --
+    # Acks count for slots I'm DRIVING: my owned slots (ballot 0) and
+    # takeover slots whose current ballot carries my id in its low bits
+    # (make_ballot(counter, me) — successor-driven slots are not owned)
+    rel_r, in_win_r = _rel(state, inbox.inst, S)
+    rel_r_safe = jnp.minimum(rel_r, S - 1)
+    drv = (jnp.mod(inbox.inst, R) == me) | (
+        (state.ballot[rel_r_safe] > 0)
+        & (jnp.mod(state.ballot[rel_r_safe], 16) == me))
+    ar_ok = is_areply & in_win_r & (inbox.op > 0) & drv
+    state = state._replace(
+        votes=state.votes.at[
+            jnp.where(ar_ok, rel_r, S), jnp.clip(inbox.src, 0, R - 1)
+        ].set(True, mode="drop"))
+
+    # ---- 6. COMMIT rows (explicit commit transfer, bcastCommit) ----
+    rel_c, in_win_c = _rel(state, inbox.inst, S)
+    com_ok = is_commit & in_win_c
+    tgt_c = jnp.where(com_ok, rel_c, S)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_c].set(inbox.ballot, mode="drop"),
+        status=state.status.at[tgt_c].max(COMMITTED, mode="drop"),
+        op=state.op.at[tgt_c].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt_c].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt_c].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt_c].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt_c].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt_c].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt_c].set(inbox.client_id, mode="drop"),
+        crt_inst=jnp.maximum(
+            state.crt_inst, jnp.max(jnp.where(com_ok, inbox.inst, -1)) + 1),
+    )
+
+    # ---- 7. takeover phase 1 (forceCommit :244-257, :878-897) ----
+    # 7a. answer PREPARE_INST: my slot contents or explicit empty; a
+    # promise here blocks my own future ballot-0 writes only if the
+    # slot was still NONE (owner priority is forfeited once a takeover
+    # ballot touches the slot — tracked via ballot bump below).
+    rel_pi, in_win_pi = _rel(state, inbox.inst, S)
+    rel_pi_safe = jnp.minimum(rel_pi, S - 1)
+    pi_answer = is_pinst & (in_win_pi | (inbox.inst >= state.crt_inst))
+    pi_com = pi_answer & in_win_pi & (state.status[rel_pi_safe] >= COMMITTED)
+    pi_occ = (pi_answer & ~pi_com & in_win_pi
+              & (state.status[rel_pi_safe] >= ACCEPTED))
+    pi_val = pi_com | pi_occ
+    # promise: bump slot ballot so ballot-0 owner writes lose from here
+    prom = pi_answer & ~pi_com & in_win_pi & (
+        inbox.ballot > state.ballot[rel_pi_safe])
+    state = state._replace(
+        ballot=state.ballot.at[jnp.where(prom, rel_pi, S)].max(
+            inbox.ballot, mode="drop"))
+    out = out._replace(
+        kind=jnp.where(pi_com, int(MsgKind.COMMIT),
+                       jnp.where(pi_answer & ~pi_com,
+                                 int(MsgKind.PREPARE_INST_REPLY), out.kind)),
+        src=jnp.where(pi_answer, me, out.src),
+        inst=jnp.where(pi_answer, inbox.inst, out.inst),
+        ballot=jnp.where(pi_val, state.ballot[rel_pi_safe],
+                         jnp.where(pi_answer, NO_BALLOT, out.ballot)),
+        last_committed=jnp.where(pi_answer, inbox.ballot,
+                                 out.last_committed),
+        op=jnp.where(pi_val, state.op[rel_pi_safe],
+                     jnp.where(pi_answer, 0, out.op)),
+        key_hi=jnp.where(pi_val, state.key_hi[rel_pi_safe], out.key_hi),
+        key_lo=jnp.where(pi_val, state.key_lo[rel_pi_safe], out.key_lo),
+        val_hi=jnp.where(pi_val, state.val_hi[rel_pi_safe], out.val_hi),
+        val_lo=jnp.where(pi_val, state.val_lo[rel_pi_safe], out.val_lo),
+        cmd_id=jnp.where(pi_val, state.cmd_id[rel_pi_safe], out.cmd_id),
+        client_id=jnp.where(pi_val, state.client_id[rel_pi_safe],
+                            out.client_id),
+    )
+    dst = jnp.where(pi_answer, inbox.src, dst)
+
+    # 7b. collect PREPARE_INST_REPLY answers (mine): pvotes + adoption
+    rel_v, in_win_v = _rel(state, inbox.inst, S)
+    rel_v_safe = jnp.minimum(rel_v, S - 1)
+    pv_ok = (is_pir & (inbox.last_committed == state.takeover_ballot)
+             & in_win_v)
+    state = state._replace(
+        pvotes=state.pvotes.at[
+            jnp.where(pv_ok, rel_v, S), jnp.clip(inbox.src, 0, R - 1)
+        ].set(True, mode="drop"))
+    pir_ok = (pv_ok & (state.status[rel_v_safe] < COMMITTED)
+              & (inbox.ballot > NO_BALLOT)
+              & (inbox.ballot > state.ballot[rel_v_safe]))
+    vb_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
+        jnp.where(pir_ok, rel_v, S)].max(inbox.ballot, mode="drop")
+    pir_win = pir_ok & (inbox.ballot == vb_max[rel_v_safe])
+    tgt_v = jnp.where(pir_win, rel_v, S)
+    state = state._replace(
+        ballot=state.ballot.at[tgt_v].set(inbox.ballot, mode="drop"),
+        status=state.status.at[tgt_v].set(ACCEPTED, mode="drop"),
+        op=state.op.at[tgt_v].set(inbox.op, mode="drop"),
+        key_hi=state.key_hi.at[tgt_v].set(inbox.key_hi, mode="drop"),
+        key_lo=state.key_lo.at[tgt_v].set(inbox.key_lo, mode="drop"),
+        val_hi=state.val_hi.at[tgt_v].set(inbox.val_hi, mode="drop"),
+        val_lo=state.val_lo.at[tgt_v].set(inbox.val_lo, mode="drop"),
+        cmd_id=state.cmd_id.at[tgt_v].set(inbox.cmd_id, mode="drop"),
+        client_id=state.client_id.at[tgt_v].set(inbox.client_id, mode="drop"),
+        votes=state.votes.at[tgt_v].set(
+            jnp.broadcast_to(self_vote, (M, R)), mode="drop"),
+    )
+
+    # ---- 8. commit scan: my owned slots at majority, frontier ----
+    n_votes = state.votes.sum(axis=1)
+    driven_by_me = own_mask | (
+        (state.ballot > 0) & (jnp.mod(state.ballot, 16) == me))
+    my_commit = (driven_by_me & (state.status == ACCEPTED)
+                 & (n_votes >= majority))
+    state = state._replace(
+        status=jnp.where(my_commit, COMMITTED, state.status))
+    old_upto = state.committed_upto
+    start_rel = state.committed_upto + 1 - state.window_base
+    frontier_rel = commit_frontier(state.status >= COMMITTED, start_rel)
+    state = state._replace(
+        committed_upto=jnp.maximum(state.committed_upto,
+                                   frontier_rel + state.window_base))
+    advanced = state.committed_upto > old_upto
+    in_flight = state.crt_inst - 1 > state.committed_upto
+    state = state._replace(
+        tick=state.tick + 1,
+        stall_ticks=jnp.where(in_flight & ~advanced,
+                              state.stall_ticks + 1, 0))
+
+    # ---- 9. chunked COMMIT broadcast for my newly committed slots ----
+    # Strides over MY OWN slots (me, me+R, ...): a window over raw log
+    # slots would contain only 1/R own slots, capping the announce rate
+    # at catchup_rows/R per step — below the proposal rate, so the
+    # cluster frontier (which needs every owner's commits) would lag
+    # unboundedly. commit_sent is the last own slot announced; foreign
+    # commits are their owners' jobs (takeover commits: see 9b).
+    K = cfg.catchup_rows
+    # never let the cursor fall below the window (slid-out slots were
+    # executed everywhere; pinning there would wedge the broadcast)
+    state = state._replace(
+        commit_sent=jnp.maximum(state.commit_sent, state.window_base - 1))
+    cb0 = state.commit_sent + 1
+    cb0 = cb0 + jnp.mod(me - cb0, R)  # first own slot > commit_sent
+    cb_slots = cb0 + R * jnp.arange(K, dtype=jnp.int32)
+    cb_rel = cb_slots - state.window_base
+    cb_rel_safe = jnp.clip(cb_rel, 0, S - 1)
+    # no-op commits (ceded slots) broadcast too: harmless duplicate of
+    # their SKIP; receivers' status guards make both idempotent.
+    cb_ok = ((cb_rel >= 0) & (cb_rel < S)
+             & (state.status[cb_rel_safe] >= COMMITTED))
+    cb = MsgBatch(
+        kind=jnp.where(cb_ok, int(MsgKind.COMMIT), 0).astype(jnp.int32),
+        src=jnp.full(K, me, jnp.int32),
+        ballot=state.ballot[cb_rel_safe],
+        inst=cb_slots,
+        last_committed=jnp.full(K, state.committed_upto, jnp.int32),
+        op=state.op[cb_rel_safe],
+        key_hi=state.key_hi[cb_rel_safe],
+        key_lo=state.key_lo[cb_rel_safe],
+        val_hi=state.val_hi[cb_rel_safe],
+        val_lo=state.val_lo[cb_rel_safe],
+        cmd_id=state.cmd_id[cb_rel_safe],
+        client_id=state.client_id[cb_rel_safe],
+    )
+    # advance through the committed prefix of my own-slot stride
+    resolved = cb_ok
+    pending_first = jnp.argmin(resolved.astype(jnp.int32))
+    n_resolved = jnp.where(resolved.all(), K, pending_first)
+    state = state._replace(
+        commit_sent=jnp.maximum(
+            state.commit_sent, cb0 + R * n_resolved - R) )
+    # 9b. takeover-commit announce: slots I committed at a takeover
+    # ballot are NOT ≡ me (mod R) so the stride broadcast misses them,
+    # and my own frontier jumps past them the moment they commit — so
+    # the window is anchored at the EPISODE's blocking slot (tk_anchor,
+    # set in step 10) and keeps re-announcing until the slots slide out
+    # or a new episode moves the anchor (bounded duplicates; self-
+    # healing against commit-row loss).
+    K2b = cfg.recovery_rows
+    ta_slots = state.tk_anchor + jnp.arange(K2b, dtype=jnp.int32)
+    ta_rel = ta_slots - state.window_base
+    ta_rel_safe = jnp.clip(ta_rel, 0, S - 1)
+    ta_ok = ((state.tk_anchor >= 0) & (ta_rel >= 0) & (ta_rel < S)
+             & (state.status[ta_rel_safe] >= COMMITTED)
+             & (state.ballot[ta_rel_safe] > 0)
+             & (jnp.mod(state.ballot[ta_rel_safe], 16) == me))
+    ta = MsgBatch(
+        kind=jnp.where(ta_ok, int(MsgKind.COMMIT), 0).astype(jnp.int32),
+        src=jnp.full(K2b, me, jnp.int32),
+        ballot=state.ballot[ta_rel_safe],
+        inst=ta_slots,
+        last_committed=jnp.full(K2b, state.committed_upto, jnp.int32),
+        op=state.op[ta_rel_safe],
+        key_hi=state.key_hi[ta_rel_safe],
+        key_lo=state.key_lo[ta_rel_safe],
+        val_hi=state.val_hi[ta_rel_safe],
+        val_lo=state.val_lo[ta_rel_safe],
+        cmd_id=state.cmd_id[ta_rel_safe],
+        client_id=state.client_id[ta_rel_safe],
+    )
+
+    # ---- 10. takeover driver: successor sweeps the blocked range ----
+    blocking = state.committed_upto + 1
+    blk_owner = jnp.mod(blocking, R)
+    i_am_successor = jnp.mod(blk_owner + 1, R) == me
+    do_tk = (i_am_successor & in_flight
+             & (state.stall_ticks >= cfg.noop_delay))
+    # fresh takeover ballot when starting a new takeover episode
+    new_tb = make_ballot(state.max_recv_ballot // 16 + 1, me)
+    tb = jnp.where(do_tk & (state.takeover_ballot < 0), new_tb,
+                   state.takeover_ballot)
+    fresh = do_tk & (state.takeover_ballot < 0)
+    state = state._replace(
+        takeover_ballot=tb,
+        max_recv_ballot=jnp.maximum(state.max_recv_ballot, tb),
+        pvotes=jnp.where(fresh, jnp.zeros((S, R), bool), state.pvotes),
+        tk_anchor=jnp.where(fresh, blocking, state.tk_anchor),
+    )
+    K2 = cfg.recovery_rows
+    tk_slots = blocking + jnp.arange(K2, dtype=jnp.int32)
+    tk_rel = tk_slots - state.window_base
+    tk_rel_safe = jnp.clip(tk_rel, 0, S - 1)
+    tk_ok = (do_tk & (tk_slots < state.crt_inst) & (tk_rel >= 0)
+             & (tk_rel < S))
+    tk = MsgBatch.empty(K2)._replace(
+        kind=jnp.where(tk_ok, int(MsgKind.PREPARE_INST), 0).astype(jnp.int32),
+        src=jnp.full(K2, me, jnp.int32),
+        ballot=jnp.full(K2, tb, jnp.int32),
+        inst=tk_slots,
+    )
+    state = state._replace(
+        pvotes=state.pvotes.at[
+            jnp.where(tk_ok, tk_rel, S), me].set(True, mode="drop"))
+    # no-op fill empties with a phase-1 majority; re-drive adopted
+    # values; both as ACCEPTs at the takeover ballot
+    pv_cnt = state.pvotes.sum(axis=1)
+    in_tk_span = (idx_abs >= blocking) & (
+        idx_abs < blocking + K2) & (idx_abs < state.crt_inst)
+    fill = (do_tk & in_tk_span & (state.status == NONE)
+            & (pv_cnt >= majority))
+    state = state._replace(
+        status=jnp.where(fill, ACCEPTED, state.status),
+        ballot=jnp.where(fill, tb, state.ballot),
+        op=jnp.where(fill, int(Op.NONE), state.op),
+        cmd_id=jnp.where(fill, 0, state.cmd_id),
+        client_id=jnp.where(fill, -1, state.client_id),
+        votes=jnp.where(fill[:, None], self_vote[None, :], state.votes),
+    )
+    redrive = (do_tk & in_tk_span & (state.status == ACCEPTED)
+               & ((state.ballot == tb) | (pv_cnt >= majority)))
+    bump = redrive & (state.ballot != tb)
+    state = state._replace(
+        ballot=jnp.where(bump, tb, state.ballot),
+        votes=jnp.where(bump[:, None], self_vote[None, :], state.votes),
+    )
+    rd_slots = blocking + jnp.arange(K2, dtype=jnp.int32)
+    rd_rel_safe = jnp.clip(rd_slots - state.window_base, 0, S - 1)
+    rd_ok = tk_ok & redrive[rd_rel_safe]
+    rd = MsgBatch(
+        kind=jnp.where(rd_ok, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
+        src=jnp.full(K2, me, jnp.int32),
+        ballot=jnp.full(K2, tb, jnp.int32),
+        inst=rd_slots,
+        last_committed=jnp.full(K2, state.committed_upto, jnp.int32),
+        op=state.op[rd_rel_safe],
+        key_hi=state.key_hi[rd_rel_safe],
+        key_lo=state.key_lo[rd_rel_safe],
+        val_hi=state.val_hi[rd_rel_safe],
+        val_lo=state.val_lo[rd_rel_safe],
+        cmd_id=state.cmd_id[rd_rel_safe],
+        client_id=state.client_id[rd_rel_safe],
+    )
+    # takeover episode ends when the frontier moves again
+    state = state._replace(
+        takeover_ballot=jnp.where(advanced, jnp.int32(NO_BALLOT),
+                                  state.takeover_ballot))
+
+    out = _concat_rows(_concat_rows(_concat_rows(_concat_rows(_concat_rows(
+        out, skip_row), cb), ta), tk), rd)
+    dst = jnp.concatenate([
+        dst,
+        jnp.full(1, -1, jnp.int32),    # skip broadcast
+        jnp.full(K, -1, jnp.int32),    # own-commit broadcast
+        jnp.full(K2b, -1, jnp.int32),  # takeover-commit announce
+        jnp.full(K2, -1, jnp.int32),   # takeover sweep
+        jnp.full(K2, -1, jnp.int32),   # takeover re-drive
+    ])
+
+    # ---- 11. conflict-aware out-of-order execution (:799-876) ----
+    # A committed, unexecuted slot executes this step iff every EARLIER
+    # window slot that conflicts with it (same key, at least one PUT —
+    # state.go:55-62) is already executed-or-being-executed. We take
+    # the contiguous executable prefix [executed_upto+1, frontier] AND
+    # any committed slot above the frontier whose conflicts are all
+    # committed below it with no uncommitted conflicting predecessor.
+    E = cfg.exec_batch
+    exec_lo = state.executed_upto + 1
+    rel_e0 = exec_lo - state.window_base
+    # in-order part
+    avail = state.committed_upto - state.executed_upto
+    n_inorder = jnp.clip(avail, 0, E)
+    in_prefix = (idx >= rel_e0) & (idx < rel_e0 + n_inorder)
+    # out-of-order part: committed slots above the frontier with no
+    # uncommitted conflicting predecessor in the window. Sort by
+    # (key, slot); an uncommitted write "poisons" every later slot of
+    # the same key via a segmented running max.
+    key_sort_hi = state.key_hi
+    key_sort_lo = state.key_lo
+    rows_w = jnp.arange(S, dtype=jnp.int32)
+    order = jnp.lexsort((rows_w, key_sort_lo, key_sort_hi))
+    s_status = state.status[order]
+    s_op = state.op[order]
+    s_key_hi = key_sort_hi[order]
+    s_key_lo = key_sort_lo[order]
+    pos = jnp.arange(S, dtype=jnp.int32)
+    seg_start = (pos == 0) | (s_key_hi != jnp.roll(s_key_hi, 1)) | (
+        s_key_lo != jnp.roll(s_key_lo, 1))
+    live = (s_status >= ACCEPTED) & (s_status < EXECUTED)
+    uncommitted_write = ((s_status == ACCEPTED)
+                         & ((s_op == int(Op.PUT))
+                            | (s_op == int(Op.DELETE))))
+    # also: ANY unexecuted write below blocks a GET; any unexecuted
+    # slot of same key blocks a WRITE (sequential-equivalence); use
+    # conservative rule: blocked if any same-key slot with smaller slot
+    # number is not yet executed and not in this step's in-order prefix
+    not_done = live & ~state.executed[order] & ~in_prefix[order]
+    poison = jnp.where(not_done | uncommitted_write, pos, -1)
+    last_poison = segmented_scan_max(poison, seg_start)
+    # slot is clear if no poison strictly before it in its key segment
+    prev_poison = jnp.where(seg_start, -1,
+                            jnp.concatenate([jnp.array([-1]),
+                                             last_poison[:-1]]))
+    clear_sorted = prev_poison < 0
+    clear = jnp.zeros(S, bool).at[order].set(clear_sorted)
+    # gap barrier: a NONE slot above the frontier has UNKNOWN future
+    # content (its key can't be consulted), so nothing beyond the first
+    # such gap may execute early — otherwise a later-committed PUT in
+    # the gap would be serialized after a GET that should have seen it
+    first_gap = jnp.min(jnp.where(
+        (idx_abs > state.committed_upto) & (state.status == NONE),
+        idx_abs, jnp.int32(2 ** 30)))
+    ooo = ((state.status == COMMITTED) & ~state.executed & ~in_prefix
+           & (idx_abs > state.committed_upto) & (idx_abs < first_gap)
+           & clear)
+    # compact: in-order prefix first (slot order), then OOO slots up to
+    # the E budget; slots already executed out-of-order must not run
+    # again when the in-order prefix sweeps past them
+    want = (in_prefix & ~state.executed) | ooo
+    exec_rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+    take = want & (exec_rank < E)
+    slot_of = jnp.full(E, S, jnp.int32).at[
+        jnp.where(take, exec_rank, E)].min(idx, mode="drop")
+    evalid = slot_of < S
+    slot_of_safe = jnp.clip(slot_of, 0, S - 1)
+    kv, o_hi, o_lo, o_found = kv_apply_batch(
+        state.kv,
+        jnp.where(evalid, state.op[slot_of_safe], 0),
+        state.key_hi[slot_of_safe],
+        state.key_lo[slot_of_safe],
+        state.val_hi[slot_of_safe],
+        state.val_lo[slot_of_safe],
+        evalid,
+    )
+    newly_exec = jnp.zeros(S, bool).at[
+        jnp.where(evalid, slot_of, S)].set(True, mode="drop")
+    state = state._replace(
+        kv=kv,
+        executed=state.executed | newly_exec,
+        status=jnp.where(newly_exec, EXECUTED, state.status),
+    )
+    # executed_upto advances through the contiguous executed prefix
+    ex_rel = commit_frontier(state.executed | (state.status >= EXECUTED),
+                             state.executed_upto + 1 - state.window_base)
+    state = state._replace(
+        executed_upto=jnp.maximum(state.executed_upto,
+                                  ex_rel + state.window_base))
+    execr = ExecResult(
+        lo=exec_lo, count=evalid.sum(),
+        val_hi=o_hi, val_lo=o_lo, found=o_found,
+        op=jnp.where(evalid, state.op[slot_of_safe], 0),
+        cmd_id=jnp.where(evalid, state.cmd_id[slot_of_safe], 0),
+        client_id=jnp.where(evalid, state.client_id[slot_of_safe], 0),
+    )
+
+    # ---- 12. window slide (same scheme as minpaxos step 9) ----
+    if cfg.slide_window:
+        retention = cfg.retention if cfg.retention >= 0 else S // 2
+        exec_edge = state.executed_upto + 1
+        target = exec_edge - retention
+        shift = jnp.clip(target - state.window_base, 0, S)
+        gone = idx >= (S - shift)
+
+        def slide(a, fill):
+            rolled = jnp.roll(a, -shift, axis=0)
+            m = gone if a.ndim == 1 else gone[:, None]
+            return jnp.where(m, fill, rolled)
+
+        state = state._replace(
+            ballot=slide(state.ballot, NO_BALLOT),
+            status=slide(state.status, NONE),
+            op=slide(state.op, 0),
+            key_hi=slide(state.key_hi, 0),
+            key_lo=slide(state.key_lo, 0),
+            val_hi=slide(state.val_hi, 0),
+            val_lo=slide(state.val_lo, 0),
+            cmd_id=slide(state.cmd_id, 0),
+            client_id=slide(state.client_id, 0),
+            votes=slide(state.votes, False),
+            pvotes=slide(state.pvotes, False),
+            executed=slide(state.executed, False),
+            window_base=state.window_base + shift,
+        )
+    return state, Outbox(msgs=out, dst=dst), execr
+
+
+mencius_step = jax.jit(mencius_step_impl, static_argnums=0,
+                       donate_argnums=1)
+
+
+class MenciusCluster:
+    """Pod-mode Mencius harness: N multi-leader replicas on device,
+    messages routed as array ops (the Mencius analogue of
+    models/cluster.py's Cluster — there is no elect(): every replica
+    serves proposals into its owned slots from boot)."""
+
+    def __init__(self, cfg: MinPaxosConfig, ext_rows: int = 1024):
+        from minpaxos_tpu.models.cluster import ClusterState, cluster_step
+
+        self.cfg = cfg
+        self.ext_rows = ext_rows
+        self._cluster_step = cluster_step
+        states = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[init_mencius(cfg, i) for i in range(cfg.n_replicas)])
+        self.cs = ClusterState(
+            states=states,
+            pending=jax.tree_util.tree_map(
+                lambda x: jnp.zeros((cfg.n_replicas,) + x.shape, x.dtype),
+                MsgBatch.empty(cfg.inbox)),
+            alive=jnp.ones(cfg.n_replicas, dtype=bool),
+        )
+        self._ext_queue: list[tuple[int, object]] = []
+        self.replies: dict[tuple[int, int], dict] = {}
+        self.reply_log: list[dict] = []
+        self._proposed_at: dict[tuple[int, int], int] = {}
+
+    def kill(self, replica: int) -> None:
+        self.cs = self.cs._replace(alive=self.cs.alive.at[replica].set(False))
+
+    def revive(self, replica: int) -> None:
+        self.cs = self.cs._replace(alive=self.cs.alive.at[replica].set(True))
+
+    def propose(self, ops, keys, vals, cmd_ids, client_id: int, to: int):
+        """Queue PROPOSE rows for owner ``to`` — ANY replica serves
+        proposals in Mencius (multi-leader); no leader discovery."""
+        from minpaxos_tpu.ops.packed import split_i64
+
+        ops = np.asarray(ops, dtype=np.int32)
+        k_hi, k_lo = split_i64(np.asarray(keys))
+        v_hi, v_lo = split_i64(np.asarray(vals))
+        n = len(ops)
+        row = dict(
+            kind=np.full(n, int(MsgKind.PROPOSE), np.int32),
+            src=np.full(n, -1, np.int32),
+            ballot=np.zeros(n, np.int32),
+            inst=np.zeros(n, np.int32),
+            last_committed=np.zeros(n, np.int32),
+            op=ops,
+            key_hi=k_hi.astype(np.int32), key_lo=k_lo.astype(np.int32),
+            val_hi=v_hi.astype(np.int32), val_lo=v_lo.astype(np.int32),
+            cmd_id=np.asarray(cmd_ids, dtype=np.int32),
+            client_id=np.full(n, client_id, np.int32),
+        )
+        for mid in np.asarray(cmd_ids, dtype=np.int64):
+            self._proposed_at[(client_id, int(mid))] = to
+        batch = MsgBatch(**{f: row[f] for f in MsgBatch._fields})
+        for lo in range(0, n, self.ext_rows):
+            self._ext_queue.append((to, jax.tree_util.tree_map(
+                lambda x: x[lo: lo + self.ext_rows], batch)))
+
+    def _drain_ext(self) -> MsgBatch:
+        r, m = self.cfg.n_replicas, self.ext_rows
+        cols = {f: np.zeros((r, m), np.int32) for f in MsgBatch._fields}
+        fill = [0] * r
+        rest = []
+        for to, rows in self._ext_queue:
+            arrs = rows._asdict() if isinstance(rows, MsgBatch) else rows
+            n = np.atleast_1d(arrs["kind"]).shape[0]
+            if fill[to] + n > m:
+                rest.append((to, rows))
+                continue
+            sl = slice(fill[to], fill[to] + n)
+            for f in MsgBatch._fields:
+                cols[f][to, sl] = arrs[f]
+            fill[to] += n
+        self._ext_queue = rest
+        return MsgBatch(**{f: jnp.asarray(cols[f]) for f in MsgBatch._fields})
+
+    def step(self) -> None:
+        ext = self._drain_ext()
+        self.cs, execr, _, _ = self._cluster_step(
+            self.cfg, self.cs, ext, mencius_step_impl)
+        self._collect_exec(execr)
+
+    def run(self, n: int) -> None:
+        for _ in range(n):
+            self.step()
+
+    def _collect_exec(self, execr: ExecResult) -> None:
+        counts = np.asarray(execr.count)
+        e_vhi, e_vlo = np.asarray(execr.val_hi), np.asarray(execr.val_lo)
+        e_found, e_op = np.asarray(execr.found), np.asarray(execr.op)
+        e_cid, e_mid = np.asarray(execr.client_id), np.asarray(execr.cmd_id)
+        from minpaxos_tpu.ops.packed import join_i64
+
+        for rep in range(self.cfg.n_replicas):
+            n = int(counts[rep])
+            if not n:
+                continue
+            vals = join_i64(e_vhi[rep][:n], e_vlo[rep][:n])
+            for i in range(n):
+                cid, mid = int(e_cid[rep][i]), int(e_mid[rep][i])
+                if cid < 0 or (e_op[rep][i] == 0 and mid == 0):
+                    continue  # no-op / skip fill
+                if self._proposed_at.get((cid, mid)) != rep:
+                    continue
+                rep_row = dict(ok=True, value=int(vals[i]),
+                               found=bool(e_found[rep][i]),
+                               op=int(e_op[rep][i]))
+                if (cid, mid) in self.replies:
+                    self.reply_log.append(dict(duplicate=True,
+                                               client_id=cid, cmd_id=mid))
+                self.replies[(cid, mid)] = rep_row
+                self.reply_log.append(dict(duplicate=False, client_id=cid,
+                                           cmd_id=mid, **rep_row))

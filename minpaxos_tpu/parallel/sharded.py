@@ -37,12 +37,14 @@ from minpaxos_tpu.models.minpaxos import (
     MsgBatch,
     become_leader,
     init_replica,
+    replica_step_impl,
 )
 from minpaxos_tpu.wire.messages import MsgKind, Op
 
 
-def _init_sharded(cfg: MinPaxosConfig, n_shards: int) -> ClusterState:
-    states = _tree_stack([init_replica(cfg, i) for i in range(cfg.n_replicas)])
+def _init_sharded(cfg: MinPaxosConfig, n_shards: int,
+                  init_fn=init_replica) -> ClusterState:
+    states = _tree_stack([init_fn(cfg, i) for i in range(cfg.n_replicas)])
     # broadcast one zeroed group to all shards
     def tile(x):
         return jnp.broadcast_to(x[None], (n_shards,) + x.shape)
@@ -57,23 +59,28 @@ def _init_sharded(cfg: MinPaxosConfig, n_shards: int) -> ClusterState:
     )
 
 
-def init_sharded(cfg: MinPaxosConfig, n_shards: int, mesh=None) -> ClusterState:
+def init_sharded(cfg: MinPaxosConfig, n_shards: int, mesh=None,
+                 init_fn=init_replica) -> ClusterState:
     """All-shards cluster state, optionally placed along mesh axis
     'shard' (leading-axis sharding; every group fully on one device).
 
     With a mesh, the state is BORN sharded (jit out_shardings) — the
     full [G, ...] tree never materializes on a single device, which
     matters at north-star scale (1024 shards of KV tables would OOM one
-    chip)."""
+    chip). ``init_fn`` is the protocol's per-replica init (static):
+    init_replica for the paxos family, models/mencius.py's init_mencius
+    for Mencius."""
     if mesh is None:
-        return jax.jit(_init_sharded, static_argnums=(0, 1))(cfg, n_shards)
+        return jax.jit(_init_sharded, static_argnums=(0, 1, 2))(
+            cfg, n_shards, init_fn)
     out_sharding = NamedSharding(mesh, P("shard"))  # prefix: all leaves
-    return jax.jit(_init_sharded, static_argnums=(0, 1),
-                   out_shardings=out_sharding)(cfg, n_shards)
+    return jax.jit(_init_sharded, static_argnums=(0, 1, 2),
+                   out_shardings=out_sharding)(cfg, n_shards, init_fn)
 
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=1)
-def sharded_step(cfg: MinPaxosConfig, ss: ClusterState, ext: MsgBatch):
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+def sharded_step(cfg: MinPaxosConfig, ss: ClusterState, ext: MsgBatch,
+                 step_impl=None):
     """One synchronous round for every shard: [G, R, ...] in, same out.
 
     ext is [G, R, Mext]. Returns (ss', exec results, client rows,
@@ -81,7 +88,9 @@ def sharded_step(cfg: MinPaxosConfig, ss: ClusterState, ext: MsgBatch):
     ss/ext sharded on 'shard', XLA partitions the whole step with no
     communication.
     """
-    return jax.vmap(functools.partial(cluster_step_impl, cfg))(ss, ext)
+    step = replica_step_impl if step_impl is None else step_impl
+    return jax.vmap(
+        functools.partial(cluster_step_impl, cfg, step_impl=step))(ss, ext)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 2))
@@ -132,7 +141,10 @@ def make_propose_ext(
     shard = jnp.arange(g, dtype=jnp.int32)[:, None, None]
     rep = jnp.arange(r, dtype=jnp.int32)[None, :, None]
     col = jnp.arange(m, dtype=jnp.int32)[None, None, :]
-    active = jnp.broadcast_to((rep == leader) & (col < count), (g, r, m))
+    # leader < 0 = propose to EVERY replica (the Mencius multi-leader
+    # workload: each owner serves its own clients)
+    active = jnp.broadcast_to(
+        ((rep == leader) | (leader < 0)) & (col < count), (g, r, m))
     mix = (shard * jnp.int32(40503) + col * jnp.int32(-1640531527)
            + seed * jnp.int32(97)) & jnp.int32(key_space - 1)
     z = jnp.zeros((g, r, m), jnp.int32)
@@ -152,9 +164,11 @@ def make_propose_ext(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=4)
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 8),
+                   donate_argnums=4)
 def sharded_run(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
-                k_rounds: int, ss: ClusterState, n_proposals, leader, seed0):
+                k_rounds: int, ss: ClusterState, n_proposals, leader, seed0,
+                step_impl=None):
     """k protocol rounds in ONE dispatch via ``lax.scan``.
 
     The per-round host round-trip (dispatch + cursor reads) dominated
@@ -169,13 +183,17 @@ def sharded_run(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
     Returns (ss', uptos [k, G], crts [k, G]).
     """
 
+    step = replica_step_impl if step_impl is None else step_impl
+    cursor_rep = jnp.maximum(leader, 0)  # mencius (-1): replica 0's view
+
     def body(ss, t):
         ext = make_propose_ext(cfg, n_shards, ext_rows, n_proposals,
                                leader, seed0 + t)
-        ss, _, _, _ = jax.vmap(functools.partial(cluster_step_impl, cfg))(
+        ss, _, _, _ = jax.vmap(
+            functools.partial(cluster_step_impl, cfg, step_impl=step))(
             ss, ext)
-        return ss, (ss.states.committed_upto[:, leader],
-                    ss.states.crt_inst[:, leader])
+        return ss, (ss.states.committed_upto[:, cursor_rep],
+                    ss.states.crt_inst[:, cursor_rep])
 
     ss, (uptos, crts) = jax.lax.scan(
         body, ss, jnp.arange(k_rounds, dtype=jnp.int32))
@@ -216,16 +234,29 @@ class ShardedCluster:
     Cluster but with everything hot staying on device."""
 
     def __init__(self, cfg: MinPaxosConfig, n_shards: int,
-                 ext_rows: int = 512, mesh=None):
+                 ext_rows: int = 512, mesh=None, protocol: str = "minpaxos"):
         self.cfg = cfg
         self.n_shards = n_shards
         self.ext_rows = ext_rows
         self.mesh = mesh
-        self.ss = init_sharded(cfg, n_shards, mesh)
-        self.leader = 0
+        self.protocol = protocol
+        if protocol == "mencius":
+            from minpaxos_tpu.models.mencius import (
+                init_mencius,
+                mencius_step_impl,
+            )
+
+            self._init_fn, self._step_impl = init_mencius, mencius_step_impl
+            self.leader = -1  # multi-leader: proposals go to every owner
+        else:  # minpaxos / classic paxos (protocol picked by cfg flag)
+            self._init_fn, self._step_impl = init_replica, replica_step_impl
+            self.leader = 0
+        self.ss = init_sharded(cfg, n_shards, mesh, self._init_fn)
         self._seed = 0
 
     def elect(self, leader: int = 0) -> None:
+        if self.protocol == "mencius":
+            raise ValueError("mencius has no elections (rotating ownership)")
         self.ss = elect_all(self.cfg, self.ss, leader)
         self.leader = leader
         self.step(0)  # deliver PREPAREs
@@ -241,7 +272,8 @@ class ShardedCluster:
                 lambda x: jax.lax.with_sharding_constraint(
                     x, NamedSharding(self.mesh, P("shard"))), ext)
         self._seed += 1
-        self.ss, _, _, _ = sharded_step(self.cfg, self.ss, ext)
+        self.ss, _, _, _ = sharded_step(self.cfg, self.ss, ext,
+                                        self._step_impl)
 
     def committed(self) -> tuple[int, int, int]:
         tot, lo, hi = commit_totals(self.cfg, self.ss)
@@ -253,7 +285,8 @@ class ShardedCluster:
         self.ss, uptos, crts = sharded_run(
             self.cfg, self.n_shards, self.ext_rows, k_rounds, self.ss,
             jnp.int32(min(n_proposals, self.ext_rows)),
-            jnp.int32(self.leader), jnp.int32(self._seed))
+            jnp.int32(self.leader), jnp.int32(self._seed),
+            self._step_impl)
         self._seed += k_rounds
         return np.asarray(uptos), np.asarray(crts)
 
