@@ -425,10 +425,19 @@ def main() -> None:
         # worker thread is daemon — the final emit still happens.
         def _guarded(fn, *a, timeout_s=600.0):
             box: list = []
-            t = threading.Thread(target=lambda: box.append(fn(*a)),
-                                 daemon=True)
+            err: list = []
+
+            def _work():
+                try:
+                    box.append(fn(*a))
+                except Exception as we:  # noqa: BLE001 — reported below
+                    err.append(we)
+
+            t = threading.Thread(target=_work, daemon=True)
             t.start()
             t.join(timeout=timeout_s)
+            if err:
+                raise err[0]  # real failure, with its real type/message
             if not box:
                 raise TimeoutError(f"side config hung > {timeout_s}s")
             return box[0]

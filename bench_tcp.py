@@ -1,0 +1,165 @@
+"""BASELINE config 1: the reference's own deployment shape, measured.
+
+Boots master + 3 MinPaxos replica servers (``-min -durable``) as REAL
+processes on localhost — the bareminrun.sh topology (reference
+bareminrun.sh:16-21) — then runs the closed-loop client with ``-check``
+(simpletest.sh:1) plus a per-op latency pass, and writes one JSON
+record to BENCH_TCP.json:
+
+    {"config": "bareminpaxos_tcp_3rep", "ops_per_sec": ...,
+     "p50_ms": ..., "p99_ms": ..., "check": "ok", ...}
+
+Run directly (``python bench_tcp.py``) or let bench.py's caller pick
+the file up next to BENCH_r{N}.json. Servers run on the CPU JAX
+backend (N processes cannot share one TPU — models/cluster.py pod mode
+is the on-accelerator deployment; this config measures the HOST
+runtime: framed TCP wire, batched column packing, durable store).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench_tcp] {msg}", file=sys.stderr, flush=True)
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def main() -> None:
+    q = int(os.environ.get("BENCH_TCP_Q", "2000"))
+    out_path = REPO / "BENCH_TCP.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    # control ports are data+1000 (reference scheme); leave headroom
+    mport = free_ports(1)[0]
+    dports = [p for p in free_ports(16) if 1024 < p < 64000][:3]
+    procs: list[subprocess.Popen] = []
+    tmp = REPO / ".bench_tcp_store"
+    tmp.mkdir(exist_ok=True)
+    for f in tmp.glob("stable-store-replica*"):
+        f.unlink()
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "minpaxos_tpu.cli.master",
+             "-port", str(mport), "-N", "3"],
+            env=env, cwd=tmp, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        time.sleep(1.5)
+        for p in dports:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "minpaxos_tpu.cli.server", "-min",
+                 "-durable", "-port", str(p), "-mport", str(mport),
+                 "-storedir", str(tmp)],
+                env=env, cwd=tmp, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        _progress("cluster booting")
+
+        from minpaxos_tpu.runtime.client import Client, gen_workload
+
+        deadline = time.monotonic() + 90
+        cli = None
+        while time.monotonic() < deadline:
+            try:
+                cli = Client(("127.0.0.1", mport), check=True)
+                break
+            except (ConnectionError, OSError, TimeoutError):
+                time.sleep(1.0)
+        if cli is None:
+            raise RuntimeError("cluster never came up")
+        _progress("client connected")
+
+        # warmup (includes the servers' first jit compiles); retried —
+        # the replicas' data listeners come up only after their first
+        # jax import/compile, well after the master answers
+        ops, keys, vals = gen_workload(100, seed=1)
+        deadline = time.monotonic() + 180
+        while True:
+            try:
+                if cli.run_workload(ops, keys, vals,
+                                    timeout_s=120)["acked"] == 100:
+                    break
+            except (ConnectionError, OSError, TimeoutError) as e:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"warmup never succeeded: {e!r}")
+                _progress(f"warmup retry ({e!r})")
+                time.sleep(2.0)
+                try:
+                    cli.close_conn()
+                except Exception:
+                    pass
+                cli = Client(("127.0.0.1", mport), check=True)
+        cli.replies.clear()
+
+        # throughput leg: q closed-loop batched requests, -check
+        ops, keys, vals = gen_workload(q, seed=42)
+        t0 = time.perf_counter()
+        stats = cli.run_workload(ops, keys, vals, timeout_s=120)
+        wall = time.perf_counter() - t0
+        ok = (stats["acked"] == q and stats["duplicates"] == 0)
+
+        # latency leg: 200 serial one-at-a-time ops with UNIQUE cmd_ids
+        # (clientlat shape, reference clientlat/client.go:134-160)
+        import numpy as np
+
+        lats = []
+        cli.replies.clear()
+        for i in range(200):
+            cid = np.asarray([100000 + i])
+            t1 = time.perf_counter()
+            cli.propose(cid, np.asarray([1]), np.asarray([7000 + i]),
+                        np.asarray([i]))
+            if cli.wait(cid, timeout_s=10.0):
+                lats.append((time.perf_counter() - t1) * 1e3)
+        lats.sort()
+        rec = {
+            "config": "bareminpaxos_tcp_3rep_durable (BASELINE config 1)",
+            "ops_per_sec": round(q / wall, 1),
+            "acked": stats["acked"],
+            "check": "ok" if ok else f"FAILED {stats}",
+            "serial_p50_ms": round(lats[len(lats) // 2], 3) if lats else None,
+            "serial_p99_ms": round(lats[int(len(lats) * 0.99)], 3)
+            if lats else None,
+            "n_serial": len(lats),
+            "reference_shape": "bareminrun.sh:16-21 + simpletest.sh:1",
+        }
+        out_path.write_text(json.dumps(rec) + "\n")
+        print(json.dumps(rec))
+        cli.close_conn()
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        time.sleep(1.0)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for f in tmp.glob("stable-store-replica*"):
+            f.unlink()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,20 +1,44 @@
 """Benchmark client binary.
 
 Flag surface follows the reference client family (client.go:19-31,
-clientretry.go, clientlat/clienttot — SURVEY.md section 2.4):
-``-q`` requests per round, ``-r`` rounds, ``-c`` conflict percent,
-``-z`` Zipfian exponent, ``-w`` write percent, ``-check`` exactly-once
-validation, ``-lat`` per-request latency mode (clientlat's
-one-outstanding-request probe), ``-tot`` throughput-over-time samples
-(clienttot's 10ms buckets).
+clientretry.go, clientlat/clienttot/client-ol-lat — SURVEY.md section
+2.4): ``-q`` requests per round, ``-r`` rounds, ``-c`` conflict
+percent, ``-z`` Zipfian exponent, ``-w`` write percent, ``-check``
+exactly-once validation, ``-lat`` per-request latency mode (clientlat's
+one-outstanding-request probe, clientlat/client.go:134-160), ``-tot``
+throughput-over-time (clienttot's 10ms buckets smoothed over 50,
+clienttot/client.go:278-300), ``-ol`` open-loop paced submission with
+reply-timestamp latency (client-ol-lat/client.go:153-183; ``-ns``
+paces one ``-batch`` per interval).
 """
 
 from __future__ import annotations
 
 import argparse
+import threading
 import time
 
 import numpy as np
+
+
+def _tot_sampler(cli, stop, counts, interval_s=0.01):
+    """clienttot: sample cumulative acked every 10ms
+    (clienttot/client.go:229-238)."""
+    while not stop.is_set():
+        counts.append((time.monotonic(), len(cli.replies)))
+        time.sleep(interval_s)
+
+
+def _print_tot(counts, window=50):
+    """Smoothed ops/s per 10ms bucket over a 50-bucket moving window
+    (clienttot/client.go:278-300)."""
+    for i in range(window, len(counts), window // 2):
+        t1, c1 = counts[i]
+        t0, c0 = counts[i - window]
+        if t1 > t0:
+            print(f"t={t1 - counts[0][0]:7.2f}s  "
+                  f"{(c1 - c0) / (t1 - t0):10.0f} ops/s (smoothed)",
+                  flush=True)
 
 
 def main(argv=None) -> None:
@@ -31,6 +55,12 @@ def main(argv=None) -> None:
     p.add_argument("-batch", type=int, default=512)
     p.add_argument("-lat", action="store_true",
                    help="closed-loop per-request latency mode")
+    p.add_argument("-tot", action="store_true",
+                   help="throughput-over-time: 10ms buckets, 50-smoothed")
+    p.add_argument("-ol", action="store_true",
+                   help="open-loop: paced submission, reply-ts latency")
+    p.add_argument("-ns", type=int, default=1_000_000,
+                   help="open-loop pacing: ns between batches")
     p.add_argument("-timeout", type=float, default=60.0)
     args = p.parse_args(argv)
 
@@ -45,24 +75,99 @@ def main(argv=None) -> None:
             args.q, conflict_pct=args.c, zipf_s=args.z, write_pct=args.w,
             seed=42 + rnd)
         if args.lat:
-            # clientlat mode: one outstanding request, per-op latency
+            # clientlat mode: one outstanding request, per-op latency,
+            # UNIQUE cmd_ids (a reused id would match a stale reply);
+            # failover on conn loss like the closed-loop driver
             cli.connect()
             lats = []
             for i in range(args.q):
+                cid = np.asarray([i])
                 t0 = time.monotonic()
-                r = cli.run_workload(ops[i:i+1], keys[i:i+1], vals[i:i+1],
-                                     batch=1, timeout_s=args.timeout)
-                lats.append(time.monotonic() - t0)
-                total_acked += r["acked"]
-            lats_ms = np.asarray(lats) * 1e3
-            print(f"round {rnd}: p50 {np.percentile(lats_ms, 50):.3f} ms  "
-                  f"p99 {np.percentile(lats_ms, 99):.3f} ms  "
-                  f"mean {lats_ms.mean():.3f} ms", flush=True)
+                try:
+                    cli.propose(cid, ops[i:i + 1], keys[i:i + 1],
+                                vals[i:i + 1])
+                except OSError:
+                    cli._failover()
+                    cli.propose(cid, ops[i:i + 1], keys[i:i + 1],
+                                vals[i:i + 1])
+                if cli.wait(cid, timeout_s=args.timeout):
+                    lats.append(time.monotonic() - t0)
+                    total_acked += 1
+            if lats:
+                lats_ms = np.asarray(lats) * 1e3
+                print(f"round {rnd}: p50 {np.percentile(lats_ms, 50):.3f} ms"
+                      f"  p99 {np.percentile(lats_ms, 99):.3f} ms  "
+                      f"mean {lats_ms.mean():.3f} ms", flush=True)
+            else:
+                print(f"round {rnd}: 0/{args.q} acked (no latency sample)",
+                      flush=True)
+        elif args.ol:
+            # open-loop: send one -batch every -ns nanoseconds without
+            # waiting; latency = reply arrival - send time per command.
+            # Arrival is stamped by the client's reader thread
+            # (replies[cmd]["t_arrive"]) — exact, not poll-quantized.
+            cli.connect()
+            send_ts: dict[int, float] = {}
+            pace = args.ns / 1e9
+            next_t = time.monotonic()
+            for lo in range(0, args.q, args.batch):
+                idx = np.arange(lo, min(lo + args.batch, args.q))
+                now = time.monotonic()
+                if now < next_t:
+                    time.sleep(next_t - now)
+                for cid in idx:
+                    send_ts[int(cid)] = time.monotonic()
+                try:
+                    cli.propose(idx, ops[idx], keys[idx], vals[idx])
+                except OSError:
+                    cli._failover()
+                    cli.propose(idx, ops[idx], keys[idx], vals[idx])
+                next_t += pace
+            # stragglers: re-send unacked once through failover (the
+            # paced send is fire-and-forget; a dropped conn would
+            # otherwise zero the sample). Re-sent ops keep their
+            # original send_ts — honestly worse, never better.
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
+                if cli.wait(np.arange(args.q), timeout_s=2.0):
+                    break
+                missing = np.asarray(
+                    [c for c in range(args.q) if c not in cli.replies],
+                    dtype=np.int64)
+                if missing.size == 0:
+                    break
+                try:
+                    cli._failover()
+                    cli.propose(missing, ops[missing], keys[missing],
+                                vals[missing])
+                except OSError:
+                    time.sleep(0.5)
+            lats = [(e["t_arrive"] - send_ts[c]) * 1e6
+                    for c, e in list(cli.replies.items())
+                    if c in send_ts and "t_arrive" in e]
+            total_acked += len(lats)
+            if lats:
+                lq = np.asarray(sorted(lats))
+                print(f"round {rnd}: open-loop {len(lats)}/{args.q} acked, "
+                      f"p50 {np.percentile(lq, 50):.0f} us  "
+                      f"p99 {np.percentile(lq, 99):.0f} us  "
+                      f"pace {args.ns} ns/batch", flush=True)
         else:
+            counts: list = []
+            stop = threading.Event()
+            if args.tot:
+                sampler = threading.Thread(
+                    target=_tot_sampler, args=(cli, stop, counts),
+                    daemon=True)
+                sampler.start()
             t0 = time.monotonic()
             stats = cli.run_workload(ops, keys, vals, batch=args.batch,
                                      timeout_s=args.timeout)
             wall = time.monotonic() - t0
+            if args.tot:
+                stop.set()
+                sampler.join(timeout=1.0)
+                _print_tot(counts)
             total_acked += stats["acked"]
             print(f"round {rnd}: {stats['acked']}/{args.q} acked in "
                   f"{wall:.3f}s  ({stats['ops_per_s']:.0f} ops/s)",

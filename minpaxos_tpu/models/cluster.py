@@ -257,11 +257,12 @@ class Cluster:
                 continue
             n = int(counts[rep])
             vals = join_i64(e_vhi[rep][:n], e_vlo[rep][:n])
-            for i in range(n):
+            # vectorized prefilter: no-op fills (cid < 0) drop before
+            # any per-row Python runs; the dict writes below only touch
+            # rows that become actual replies
+            for i in np.nonzero(e_cid[rep][:n] >= 0)[0]:
                 cid = int(e_cid[rep][i])
                 mid = int(e_mid[rep][i])
-                if cid < 0:  # no-op fill, nobody to reply to
-                    continue
                 if self._proposed_at.get((cid, mid)) != rep:
                     continue  # executed here, but the client's conn is elsewhere
                 rep_row = dict(ok=True, value=int(vals[i]),

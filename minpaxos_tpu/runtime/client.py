@@ -124,7 +124,9 @@ class Client:
                 if cmd in self.replies:
                     self.dup_replies += 1  # -check duplicate detection
                     continue
-                entry = {"val": int(r["val"])}
+                # t_arrive: exact reader-thread arrival time, for the
+                # open-loop latency probe (a poller would quantize)
+                entry = {"val": int(r["val"]), "t_arrive": time.monotonic()}
                 if kind == MsgKind.PROPOSE_REPLY:
                     entry["ts"] = int(r["timestamp"])
                 self.replies[cmd] = entry

@@ -300,6 +300,16 @@ class ReplicaServer:
             return
         if elect:
             self._become_leader()
+            self._last_elect = time.monotonic()
+        elif (self.snapshot["leader"] == self.me
+              and not self.snapshot["prepared"]
+              and time.monotonic() - getattr(self, "_last_elect", 0.0) > 0.5):
+            # the one-shot PREPARE broadcast can be lost (a peer mid
+            # store-replay or reconnecting isn't reading yet), which
+            # would wedge an elected leader unprepared forever — re-run
+            # the prepare round at a fresh ballot until majority answers
+            self._become_leader()
+            self._last_elect = time.monotonic()
         self._device_tick(self.inbox)
         self._last_step = time.monotonic()
         self.stats["ticks"] += 1
@@ -393,6 +403,7 @@ class ReplicaServer:
             "frontier": int(np.asarray(self.state.committed_upto)),
             "leader": int(np.asarray(self.state.leader_id)),
             "prepared": bool(np.asarray(self.state.prepared)),
+            "window_base": int(np.asarray(self.state.window_base)),
         }
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
@@ -544,20 +555,33 @@ class ReplicaServer:
         cmds = np.asarray(execr.cmd_id)[:n]
         vals = join_i64(np.asarray(execr.val_hi)[:n],
                         np.asarray(execr.val_lo)[:n])
-        for i in range(n):
+        # group-by client connection: ONE frame (and one socket write)
+        # per (conn, kind) instead of a frame per executed command —
+        # the reply path must stay invisible next to the device step
+        # at bench load. No-op fills (cid < 0) are dropped vectorized.
+        writes: dict[int, tuple[list, list]] = {}
+        reads: dict[int, tuple[list, list]] = {}
+        for i in np.nonzero(cids >= 0)[0]:
             key = (int(cids[i]), int(cmds[i]))
             want = self._pending.pop(key, None)
             if want is None:
                 continue  # not proposed on this conn (or already replied)
-            if want == MsgKind.READ_REPLY:
-                frame = make_batch(MsgKind.READ_REPLY, cmd_id=key[1],
-                                   val=int(vals[i]))
-            else:
-                frame = make_batch(MsgKind.PROPOSE_REPLY, ok=1,
-                                   cmd_id=key[1], val=int(vals[i]),
-                                   timestamp=monotonic_ns(),
-                                   leader=np.int8(self.me))
-            self.transport.send_client(key[0], want, frame)
+            book = reads if want == MsgKind.READ_REPLY else writes
+            cs_, vs_ = book.setdefault(key[0], ([], []))
+            cs_.append(key[1])
+            vs_.append(int(vals[i]))
+        ts = monotonic_ns()
+        for conn, (cs_, vs_) in writes.items():
+            frame = make_batch(MsgKind.PROPOSE_REPLY, ok=1,
+                               cmd_id=np.asarray(cs_, np.int32),
+                               val=np.asarray(vs_, np.int64),
+                               timestamp=ts, leader=np.int8(self.me))
+            self.transport.send_client(conn, MsgKind.PROPOSE_REPLY, frame)
+        for conn, (cs_, vs_) in reads.items():
+            frame = make_batch(MsgKind.READ_REPLY,
+                               cmd_id=np.asarray(cs_, np.int32),
+                               val=np.asarray(vs_, np.int64))
+            self.transport.send_client(conn, MsgKind.READ_REPLY, frame)
 
     # -- beyond-window catch-up from the durable log --
 
