@@ -22,10 +22,11 @@ import json
 import os
 import pathlib
 import signal
-import socket
 import subprocess
 import sys
 import time
+
+from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
 
 REPO = pathlib.Path(__file__).resolve().parent
 
@@ -34,25 +35,22 @@ def _progress(msg: str) -> None:
     print(f"[bench_tcp] {msg}", file=sys.stderr, flush=True)
 
 
-def free_ports(n: int) -> list[int]:
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
 def main() -> None:
     q = int(os.environ.get("BENCH_TCP_Q", "2000"))
     out_path = REPO / "BENCH_TCP.json"
+    # opportunistic native build: every server/client process then
+    # loads the C++ frame scan off disk (pure-Python fallback if no g++)
+    try:
+        from minpaxos_tpu.native.build import build as _native_build
+
+        _native_build(quiet=True)
+    except Exception:
+        pass
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
-    # control ports are data+1000 (reference scheme); leave headroom
+    # control ports are data+1000 (reference scheme); pick data ports
+    # whose +1000 sibling is verified free too
     mport = free_ports(1)[0]
-    dports = [p for p in free_ports(16) if 1024 < p < 64000][:3]
+    dports = free_ports(3, sibling_offset=CONTROL_OFFSET)
     procs: list[subprocess.Popen] = []
     tmp = REPO / ".bench_tcp_store"
     tmp.mkdir(exist_ok=True)

@@ -21,20 +21,25 @@ models     Consensus protocols over the quorum kernels: bareminpaxos
            src/bareminpaxos, src/paxos, src/mencius.
 parallel   Mesh / sharding layer: shard x replica device meshes, pjit
            partitioning of the cluster step, ICI collectives.
-runtime    Host-side replica runtime: TCP peer mesh, client listener,
-           batch-draining event loop — counterpart of src/genericsmr.
-master     Cluster coordination: registration, leader election, pings —
-           counterpart of src/master.
-storage    Durable append-only redo log + crash recovery — counterpart
-           of the reference's stable-store files.
-clients    Benchmark clients (closed-loop, retry/failover, latency,
-           open-loop, throughput-over-time) — counterpart of
-           src/client*, src/clientretry, src/clientlat, ...
-sim        Deterministic in-process multi-replica simulation + fault
-           injection (the reference's kill/revive shell-script matrix,
-           made programmatic).
+runtime    Host-side runtime: TCP peer mesh + client listener +
+           batch-draining event loop (replica.py, transport.py —
+           counterpart of src/genericsmr), master coordination
+           (master.py — src/master), durable redo log + crash
+           recovery (stable.py — the reference's stable-store files),
+           and the benchmark client engine (client.py — closed-loop,
+           retry/failover, latency; counterpart of src/client*,
+           src/clientretry, src/clientlat).
+native     Optional C++ fast paths (cycle clock, wire-frame stream
+           scan) — counterpart of src/rdtsc, the reference's only
+           native component. Build: python -m minpaxos_tpu.native.build.
 cli        server / master / client entry points (flag-compatible with
-           reference src/server, src/master, src/client).
+           reference src/server, src/master, src/client; the client
+           covers -lat / -tot / open-loop modes).
+
+Fault injection is programmatic rather than a subpackage: pod-mode
+``Cluster.kill/revive`` masks and the TCP harness in
+tests/test_distributed.py replace the reference's kill/revive
+shell-script matrix.
 """
 
 __version__ = "0.1.0"

@@ -29,6 +29,26 @@ def _tot_sampler(cli, stop, counts, interval_s=0.01):
         time.sleep(interval_s)
 
 
+def _propose_retrying(cli, cmd_ids, ops, keys, vals,
+                      timeout_s: float) -> bool:
+    """Propose with failover retries until ``timeout_s`` elapses.
+
+    Returns False if every attempt raised (cluster unreachable for the
+    whole budget) — ``_failover()`` itself can return without a live
+    connection when no replica accepts TCP, so a bare retry after it
+    would crash the benchmark loop on the same OSError.
+    """
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            cli.propose(cmd_ids, ops, keys, vals)
+            return True
+        except OSError:
+            if time.monotonic() >= deadline:
+                return False
+            cli._failover()  # sleeps 0.5s itself when nothing accepts
+
+
 def _print_tot(counts, window=50):
     """Smoothed ops/s per 10ms bucket over a 50-bucket moving window
     (clienttot/client.go:278-300)."""
@@ -83,13 +103,10 @@ def main(argv=None) -> None:
             for i in range(args.q):
                 cid = np.asarray([i])
                 t0 = time.monotonic()
-                try:
-                    cli.propose(cid, ops[i:i + 1], keys[i:i + 1],
-                                vals[i:i + 1])
-                except OSError:
-                    cli._failover()
-                    cli.propose(cid, ops[i:i + 1], keys[i:i + 1],
-                                vals[i:i + 1])
+                if not _propose_retrying(cli, cid, ops[i:i + 1],
+                                         keys[i:i + 1], vals[i:i + 1],
+                                         args.timeout):
+                    continue  # cluster unreachable for the whole budget
                 if cli.wait(cid, timeout_s=args.timeout):
                     lats.append(time.monotonic() - t0)
                     total_acked += 1
@@ -117,20 +134,28 @@ def main(argv=None) -> None:
                     time.sleep(next_t - now)
                 for cid in idx:
                     send_ts[int(cid)] = time.monotonic()
-                try:
-                    cli.propose(idx, ops[idx], keys[idx], vals[idx])
-                except OSError:
-                    cli._failover()
-                    cli.propose(idx, ops[idx], keys[idx], vals[idx])
+                # one failover retry, bounded: open-loop pacing must
+                # not block indefinitely; commands lost here are
+                # re-sent by the straggler sweep below
+                _propose_retrying(cli, idx, ops[idx], keys[idx],
+                                  vals[idx], timeout_s=2.0)
                 next_t += pace
-            # stragglers: re-send unacked once through failover (the
-            # paced send is fire-and-forget; a dropped conn would
-            # otherwise zero the sample). Re-sent ops keep their
-            # original send_ts — honestly worse, never better.
+            # stragglers: re-send unacked through failover (the paced
+            # send is fire-and-forget; a dropped conn would otherwise
+            # zero the sample) — but ONLY when replies have stalled; a
+            # healthy cluster still draining the backlog keeps its
+            # connection (failover would discard in-flight replies and
+            # re-execute). Re-sent ops keep their original send_ts —
+            # honestly worse, never better.
             deadline = time.monotonic() + args.timeout
+            last_done = -1
             while time.monotonic() < deadline:
                 if cli.wait(np.arange(args.q), timeout_s=2.0):
                     break
+                done = len(cli.replies)
+                if done > last_done:
+                    last_done = done
+                    continue  # progress: still draining, don't thrash
                 missing = np.asarray(
                     [c for c in range(args.q) if c not in cli.replies],
                     dtype=np.int64)

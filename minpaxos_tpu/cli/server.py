@@ -30,7 +30,9 @@ def main(argv=None) -> None:
                         "Commit/CommitShort, per-instance ballots — "
                         "models/paxos.py; overrides -min)")
     p.add_argument("-exec", dest="exec_", action="store_true", default=True,
-                   help="execute committed commands")
+                   help="execute committed commands (accepted for "
+                        "reference flag compatibility; always on — "
+                        "execution drives window reclamation)")
     p.add_argument("-dreply", action="store_true", default=True,
                    help="reply after execution with the value")
     p.add_argument("-durable", action="store_true",
@@ -51,6 +53,15 @@ def main(argv=None) -> None:
                    help="write a profile dump on SIGINT (pprof-style)")
     args = p.parse_args(argv)
 
+    # opportunistic native-layer build (C++ frame scan + cycle clock);
+    # everything falls back to pure Python when g++ is absent
+    try:
+        from minpaxos_tpu.native.build import build as _native_build
+
+        _native_build(quiet=True)
+    except Exception:
+        pass
+
     import jax
 
     jax.config.update("jax_platforms", args.platform)
@@ -70,7 +81,7 @@ def main(argv=None) -> None:
         exec_batch=args.inbox, kv_pow2=16,
         catchup_rows=256, recovery_rows=256,
         explicit_commit=args.classic)
-    flags = RuntimeFlags(exec_=args.exec_, dreply=args.dreply,
+    flags = RuntimeFlags(dreply=args.dreply,
                          durable=args.durable, thrifty=args.thrifty,
                          beacon=args.beacon, store_dir=args.storedir)
     server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags)

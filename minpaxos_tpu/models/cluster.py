@@ -124,6 +124,72 @@ cluster_step = jax.jit(cluster_step_impl, static_argnums=(0, 3),
                        donate_argnums=1)
 
 
+def pack_reply_key(client_id, cmd_id) -> np.ndarray:
+    """(client_id, cmd_id) -> one i64 key, vectorized — lets the reply
+    collectors prefilter executed rows with ``np.isin`` instead of a
+    Python dict probe per row."""
+    return (np.asarray(client_id, np.int64) << 32) | (
+        np.asarray(cmd_id, np.int64) & 0xFFFFFFFF)
+
+
+def collect_exec_replies(cl, execr: ExecResult, *,
+                         drop_skip_fills: bool = False,
+                         record_inst: bool = True) -> None:
+    """Host side of ReplyProposeTS (genericsmr.go:529), shared by
+    Cluster and MenciusCluster (``cl`` needs cfg / _prop_keys /
+    _proposed_at / replies / reply_log).
+
+    One transfer per field, then a vectorized group-by prefilter: no-op
+    fills (cid < 0; with ``drop_skip_fills`` also Mencius SKIP fills)
+    and slots whose client proposed elsewhere drop via one ``np.isin``
+    against the replica's proposed-key set. Only rows that become
+    actual replies reach the per-row dict writes (the dict IS the
+    client-facing API). The final ``_proposed_at`` probe re-checks
+    ownership exactly: a key re-proposed to another replica after a
+    failover passes the isin prefilter but must not reply here.
+    """
+    counts = np.asarray(execr.count)
+    e_vhi, e_vlo = np.asarray(execr.val_hi), np.asarray(execr.val_lo)
+    e_found, e_op = np.asarray(execr.found), np.asarray(execr.op)
+    e_cid, e_mid = np.asarray(execr.client_id), np.asarray(execr.cmd_id)
+    e_lo = np.asarray(execr.lo) if record_inst else None
+    for rep in range(cl.cfg.n_replicas):
+        n = int(counts[rep])
+        if not n:
+            continue
+        chunks = cl._prop_keys.get(rep)
+        if not chunks:
+            continue  # nothing ever proposed to this replica
+        cid_n, mid_n, op_n = e_cid[rep][:n], e_mid[rep][:n], e_op[rep][:n]
+        cand = cid_n >= 0
+        if drop_skip_fills:
+            cand &= ~((op_n == 0) & (mid_n == 0))
+        if not cand.any():
+            continue
+        if len(chunks) > 1:  # lazy concat, cached
+            cl._prop_keys[rep] = chunks = [np.concatenate(chunks)]
+        cand &= np.isin(pack_reply_key(cid_n, mid_n), chunks[0])
+        idx = np.nonzero(cand)[0]
+        if not idx.size:
+            continue
+        vals = join_i64(e_vhi[rep][idx], e_vlo[rep][idx])
+        founds, ops = e_found[rep][idx], op_n[idx]
+        for j, i in enumerate(idx):
+            cid, mid = int(cid_n[i]), int(mid_n[i])
+            if cl._proposed_at.get((cid, mid)) != rep:
+                continue  # re-proposed elsewhere since (failover)
+            rep_row = dict(ok=True, value=int(vals[j]),
+                           found=bool(founds[j]), op=int(ops[j]))
+            if record_inst:
+                rep_row["inst"] = int(e_lo[rep]) + int(i)
+            if (cid, mid) in cl.replies:
+                cl.reply_log.append(dict(duplicate=True, client_id=cid,
+                                         cmd_id=mid))
+            cl.replies[(cid, mid)] = rep_row
+            cl.reply_log.append(dict(duplicate=False, client_id=cid,
+                                     cmd_id=mid, **rep_row))
+
+
 class Cluster:
     """Host-side convenience wrapper: boot, propose, crash, recover.
 
@@ -150,6 +216,9 @@ class Cluster:
         # proposed to replies (reference lb.clientProposals,
         # bareminpaxos.go:75-82); other replicas execute silently
         self._proposed_at: dict[tuple[int, int], int] = {}
+        # packed-key arrays per replica, the vectorized face of
+        # _proposed_at (np.isin prefilter in _collect_exec)
+        self._prop_keys: dict[int, list[np.ndarray]] = {}
 
     # -- control plane --
 
@@ -209,6 +278,8 @@ class Cluster:
         )
         for mid in np.asarray(cmd_ids, dtype=np.int64):
             self._proposed_at[(client_id, int(mid))] = to
+        self._prop_keys.setdefault(to, []).append(
+            pack_reply_key(client_id, cmd_ids))
         batch = MsgBatch(**{f: row[f] for f in MsgBatch._fields})
         for lo in range(0, n, self.ext_rows):
             self._ext_queue.append((to, jax.tree_util.tree_map(
@@ -246,45 +317,22 @@ class Cluster:
     # -- reply collection (host side of ReplyProposeTS, genericsmr.go:529) --
 
     def _collect_exec(self, execr: ExecResult) -> None:
-        counts = np.asarray(execr.count)
-        # one transfer per field, then pure-numpy indexing
-        e_vhi, e_vlo = np.asarray(execr.val_hi), np.asarray(execr.val_lo)
-        e_found, e_op = np.asarray(execr.found), np.asarray(execr.op)
-        e_cid, e_mid = np.asarray(execr.client_id), np.asarray(execr.cmd_id)
-        e_lo = np.asarray(execr.lo)
-        for rep in range(self.cfg.n_replicas):
-            if not counts[rep]:
-                continue
-            n = int(counts[rep])
-            vals = join_i64(e_vhi[rep][:n], e_vlo[rep][:n])
-            # vectorized prefilter: no-op fills (cid < 0) drop before
-            # any per-row Python runs; the dict writes below only touch
-            # rows that become actual replies
-            for i in np.nonzero(e_cid[rep][:n] >= 0)[0]:
-                cid = int(e_cid[rep][i])
-                mid = int(e_mid[rep][i])
-                if self._proposed_at.get((cid, mid)) != rep:
-                    continue  # executed here, but the client's conn is elsewhere
-                rep_row = dict(ok=True, value=int(vals[i]),
-                               found=bool(e_found[rep][i]),
-                               op=int(e_op[rep][i]),
-                               inst=int(e_lo[rep]) + i)
-                if (cid, mid) in self.replies:
-                    self.reply_log.append(dict(duplicate=True, client_id=cid,
-                                               cmd_id=mid))
-                self.replies[(cid, mid)] = rep_row
-                self.reply_log.append(dict(duplicate=False, client_id=cid,
-                                           cmd_id=mid, **rep_row))
+        collect_exec_replies(self, execr)
 
     def _collect_client_rows(self, crows: MsgBatch, cmask) -> None:
         cmask = np.asarray(cmask)
         if not cmask.any():
             return
+        # one transfer per column, then pure-numpy fancy indexing (the
+        # old path pulled each element off-device individually)
         kinds = np.asarray(crows.kind)
-        for rep, i in zip(*np.nonzero(cmask)):
-            if kinds[rep, i] == int(MsgKind.PROPOSE_REPLY):
-                cid = int(np.asarray(crows.client_id[rep, i]))
-                mid = int(np.asarray(crows.cmd_id[rep, i]))
-                self.reply_log.append(dict(
-                    duplicate=False, client_id=cid, cmd_id=mid, ok=False,
-                    leader=int(np.asarray(crows.ballot[rep, i]))))
+        sel = cmask & (kinds == int(MsgKind.PROPOSE_REPLY))
+        if not sel.any():
+            return
+        cids = np.asarray(crows.client_id)[sel]
+        mids = np.asarray(crows.cmd_id)[sel]
+        leaders = np.asarray(crows.ballot)[sel]
+        for cid, mid, ldr in zip(cids, mids, leaders):
+            self.reply_log.append(dict(
+                duplicate=False, client_id=int(cid), cmd_id=int(mid),
+                ok=False, leader=int(ldr)))

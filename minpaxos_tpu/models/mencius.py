@@ -769,6 +769,7 @@ class MenciusCluster:
         self.replies: dict[tuple[int, int], dict] = {}
         self.reply_log: list[dict] = []
         self._proposed_at: dict[tuple[int, int], int] = {}
+        self._prop_keys: dict[int, list[np.ndarray]] = {}
 
     def kill(self, replica: int) -> None:
         self.cs = self.cs._replace(alive=self.cs.alive.at[replica].set(False))
@@ -799,6 +800,10 @@ class MenciusCluster:
         )
         for mid in np.asarray(cmd_ids, dtype=np.int64):
             self._proposed_at[(client_id, int(mid))] = to
+        from minpaxos_tpu.models.cluster import pack_reply_key
+
+        self._prop_keys.setdefault(to, []).append(
+            pack_reply_key(client_id, cmd_ids))
         batch = MsgBatch(**{f: row[f] for f in MsgBatch._fields})
         for lo in range(0, n, self.ext_rows):
             self._ext_queue.append((to, jax.tree_util.tree_map(
@@ -833,29 +838,11 @@ class MenciusCluster:
             self.step()
 
     def _collect_exec(self, execr: ExecResult) -> None:
-        counts = np.asarray(execr.count)
-        e_vhi, e_vlo = np.asarray(execr.val_hi), np.asarray(execr.val_lo)
-        e_found, e_op = np.asarray(execr.found), np.asarray(execr.op)
-        e_cid, e_mid = np.asarray(execr.client_id), np.asarray(execr.cmd_id)
-        from minpaxos_tpu.ops.packed import join_i64
+        from minpaxos_tpu.models.cluster import collect_exec_replies
 
-        for rep in range(self.cfg.n_replicas):
-            n = int(counts[rep])
-            if not n:
-                continue
-            vals = join_i64(e_vhi[rep][:n], e_vlo[rep][:n])
-            for i in range(n):
-                cid, mid = int(e_cid[rep][i]), int(e_mid[rep][i])
-                if cid < 0 or (e_op[rep][i] == 0 and mid == 0):
-                    continue  # no-op / skip fill
-                if self._proposed_at.get((cid, mid)) != rep:
-                    continue
-                rep_row = dict(ok=True, value=int(vals[i]),
-                               found=bool(e_found[rep][i]),
-                               op=int(e_op[rep][i]))
-                if (cid, mid) in self.replies:
-                    self.reply_log.append(dict(duplicate=True,
-                                               client_id=cid, cmd_id=mid))
-                self.replies[(cid, mid)] = rep_row
-                self.reply_log.append(dict(duplicate=False, client_id=cid,
-                                           cmd_id=mid, **rep_row))
+        # drop_skip_fills: Mencius SKIP fills execute as (op=0, mid=0)
+        # rows that no client ever proposed; no per-slot inst is
+        # recorded because out-of-order execution makes the contiguous
+        # exec_lo+i numbering of the MinPaxos collector meaningless
+        collect_exec_replies(self, execr, drop_skip_fills=True,
+                             record_inst=False)

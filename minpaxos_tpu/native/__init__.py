@@ -1,14 +1,20 @@
-"""Optional native (C++) fast paths: cycle clock + codec stream scan.
+"""Native (C++) fast paths: cycle clock + wire-frame stream scan.
+
+Counterpart of the reference's only native component, the RDTSC shim
+(rdtsc.s:1-8), extended with the frame scan that replaces the
+per-frame Python header loop in wire/codec.py.
 
 Build with ``python -m minpaxos_tpu.native.build``; everything in the
 framework works without it (pure-Python/numpy fallbacks). ``libnative``
-is None when the shared library is absent.
+is None when the shared library is absent or unloadable.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+
+import numpy as np
 
 _LIB = os.path.join(os.path.dirname(__file__), "libminpaxos_native.so")
 
@@ -18,5 +24,55 @@ if os.path.exists(_LIB):  # pragma: no cover - depends on local build
         libnative = ctypes.CDLL(_LIB)
         libnative.mp_cputicks.restype = ctypes.c_uint64
         libnative.mp_cputicks.argtypes = []
-    except OSError:
+        libnative.mp_monotonic_ns.restype = ctypes.c_uint64
+        libnative.mp_monotonic_ns.argtypes = []
+        libnative.mp_scan_frames.restype = ctypes.c_int64
+        libnative.mp_scan_frames.argtypes = [
+            ctypes.c_void_p,                   # buf
+            ctypes.c_int64,                    # len
+            ctypes.POINTER(ctypes.c_int32),    # itemsize[256]
+            ctypes.c_int64,                    # max_rows
+            ctypes.c_int64,                    # max_frames
+            ctypes.POINTER(ctypes.c_uint8),    # out_op
+            ctypes.POINTER(ctypes.c_int64),    # out_off
+            ctypes.POINTER(ctypes.c_int64),    # out_nrows
+            ctypes.POINTER(ctypes.c_int64),    # consumed
+            ctypes.POINTER(ctypes.c_int32),    # status
+        ]
+    except (OSError, AttributeError):
         libnative = None
+
+
+def scan_frames(buf, itemsize: np.ndarray, max_rows: int
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int, bool]:
+    """Locate every complete frame in ``buf`` in one native call.
+
+    ``buf`` is bytes or bytearray (zero-copy either way). ``itemsize``
+    is an int32[256] payload-row-size table (0 = invalid opcode).
+    Returns (ops u8[n], payload_offsets i64[n], nrows i64[n],
+    consumed_bytes, corrupt). Caller must have checked ``libnative``.
+    """
+    n = len(buf)
+    if isinstance(buf, bytearray):
+        # from_buffer is zero-copy; keep `anchor` alive across the call
+        anchor = (ctypes.c_char * n).from_buffer(buf)
+        ptr = ctypes.addressof(anchor) if n else None
+    else:
+        anchor = ctypes.c_char_p(buf)  # borrows the bytes' buffer
+        ptr = ctypes.cast(anchor, ctypes.c_void_p)
+    cap = n // 5 + 1  # a frame is >= 5 header bytes
+    ops = np.empty(cap, np.uint8)
+    offs = np.empty(cap, np.int64)
+    rows = np.empty(cap, np.int64)
+    consumed = ctypes.c_int64(0)
+    status = ctypes.c_int32(0)
+    nf = libnative.mp_scan_frames(
+        ptr, n,
+        itemsize.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        max_rows, cap,
+        ops.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(consumed), ctypes.byref(status))
+    return (ops[:nf], offs[:nf], rows[:nf], consumed.value,
+            bool(status.value))

@@ -1,0 +1,52 @@
+"""Build the native fast-path library (libminpaxos_native.so).
+
+Usage::
+
+    python -m minpaxos_tpu.native.build [--force]
+
+Compiles minpaxos_tpu/native/clock.cpp with the system g++ into a
+shared library next to it. The build is skipped when the .so is newer
+than the source; ``--force`` rebuilds unconditionally. The framework
+never requires the library — wire/codec.py and utils/clock.py fall
+back to pure Python when it is absent.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "clock.cpp")
+OUT = os.path.join(_DIR, "libminpaxos_native.so")
+
+
+def build(force: bool = False, quiet: bool = False) -> str | None:
+    """Compile the library if stale; returns the .so path, or None if
+    no C++ toolchain is available."""
+    if (not force and os.path.exists(OUT)
+            and os.path.getmtime(OUT) >= os.path.getmtime(SRC)):
+        return OUT
+    tmp = OUT + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except FileNotFoundError:
+        if not quiet:
+            print("native build skipped: g++ not found", file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        if not quiet:
+            sys.stderr.write(proc.stderr)
+        raise RuntimeError(f"g++ failed (rc={proc.returncode})")
+    # atomic publish: concurrent builders (pytest workers) race safely
+    os.replace(tmp, OUT)
+    return OUT
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv[1:])
+    if path is None:
+        sys.exit(1)
+    print(path)

@@ -1,0 +1,92 @@
+// Native fast paths for the host-side runtime. Two symbols:
+//
+//   mp_cputicks()   — raw cycle counter. Counterpart of the
+//                     reference's only native component, the x86-64
+//                     RDTSC shim (rdtsc.s:1-8, rdtsc_decl.go:3) used
+//                     for beacon RTT EWMA (genericsmr.go:429,:540).
+//   mp_scan_frames  — one pass over a TCP receive buffer locating
+//                     every complete wire frame
+//                     ([opcode u8][nrows u32 LE][payload]), replacing
+//                     the per-frame Python header-parse loop in
+//                     wire/codec.py StreamDecoder.feed. The payload
+//                     itemsize per opcode comes in as a 256-entry
+//                     table (0 = invalid opcode).
+//
+// Build: python -m minpaxos_tpu.native.build  (g++ -O2 -shared -fPIC)
+// Everything in the framework works without this library; see
+// minpaxos_tpu/native/__init__.py for the ctypes bindings and
+// fallbacks.
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+extern "C" {
+
+uint64_t mp_cputicks() {
+#if defined(__x86_64__)
+    uint32_t lo, hi;
+    __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+    return (static_cast<uint64_t>(hi) << 32) | lo;
+#elif defined(__aarch64__)
+    uint64_t v;
+    asm volatile("mrs %0, cntvct_el0" : "=r"(v));
+    return v;
+#else
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC_RAW, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+           static_cast<uint64_t>(ts.tv_nsec);
+#endif
+}
+
+uint64_t mp_monotonic_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+           static_cast<uint64_t>(ts.tv_nsec);
+}
+
+// Scan [buf, buf+len) for complete frames. For each frame i found:
+//   out_op[i]    = opcode
+//   out_off[i]   = payload byte offset into buf
+//   out_nrows[i] = row count
+// Returns the number of complete frames (<= max_frames). *consumed is
+// the byte offset just past the last complete frame — the caller keeps
+// bytes [consumed, len) as the partial-frame tail. *status is 0 for a
+// clean scan (stopped at end-of-buffer / partial tail / max_frames),
+// 1 for a corrupt stream (invalid opcode or nrows > max_rows): frames
+// before the corruption are still reported, matching the Python
+// decoder's latch-after-partial-results semantics.
+int64_t mp_scan_frames(const uint8_t* buf, int64_t len,
+                       const int32_t* itemsize /* [256] */,
+                       int64_t max_rows, int64_t max_frames,
+                       uint8_t* out_op, int64_t* out_off,
+                       int64_t* out_nrows,
+                       int64_t* consumed, int32_t* status) {
+    int64_t pos = 0, nf = 0;
+    *status = 0;
+    while (nf < max_frames) {
+        if (len - pos < 5) break;  // incomplete header
+        const uint8_t op = buf[pos];
+        uint32_t nrows;
+        std::memcpy(&nrows, buf + pos + 1, 4);  // little-endian host
+        const int32_t isz = itemsize[op];
+        if (isz <= 0 || static_cast<int64_t>(nrows) > max_rows) {
+            *status = 1;  // corrupt: unknown opcode / absurd row count
+            break;
+        }
+        const int64_t end =
+            pos + 5 + static_cast<int64_t>(nrows) * isz;
+        if (end > len) break;  // incomplete payload
+        out_op[nf] = op;
+        out_off[nf] = pos + 5;
+        out_nrows[nf] = nrows;
+        pos = end;
+        ++nf;
+    }
+    *consumed = pos;
+    return nf;
+}
+
+}  // extern "C"

@@ -57,9 +57,16 @@ CONTROL = 3  # queue item source tag (transport uses 0..2)
 
 @dataclass
 class RuntimeFlags:
-    """Server knobs — the reference's flag set (server.go:19-34)."""
+    """Server knobs — the reference's flag set (server.go:19-34).
 
-    exec_: bool = True     # -exec: apply committed commands
+    The reference's ``-exec`` (run executeCommands at all) has no
+    counterpart here and is deliberately absent: execution is fused
+    into the device step and drives sliding-window reclamation
+    (models/minpaxos.py step 8 feeds step 9), so a non-executing
+    replica would wedge its own log window. The CLI still accepts
+    ``-exec`` for command-line compatibility; it is always on.
+    """
+
     dreply: bool = True    # -dreply: reply after execution (with value)
     durable: bool = False  # -durable: fsync accepted slots per tick
     thrifty: bool = False  # -thrifty: send accepts to a quorum only
@@ -97,7 +104,6 @@ class ReplicaServer:
         self.inbox = batches.ColumnBuffer(self.cfg.inbox)
         # reply bookkeeping: (conn_id, cmd_id) -> reply kind to send
         self._pending: dict[tuple[int, int], MsgKind] = {}
-        self._replied: set[tuple[int, int]] = set()
         self.rtt_ewma = np.full(len(addrs), np.inf)
         self._stop = threading.Event()
         self._recovered = self.store.recovered

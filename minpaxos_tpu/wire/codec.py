@@ -12,8 +12,13 @@ where payload is the packed numpy structured-dtype buffer for that
 opcode's schema (wire/messages.py). Encoding a frame of N messages is
 one ``ndarray.tobytes()``; decoding is one ``np.frombuffer`` — the
 row columns then feed the device batch without further transformation.
-(An optional C++ stream-scan fast path is planned under
-minpaxos_tpu/native/; nothing here depends on it.)
+
+When the optional C++ library is built (python -m
+minpaxos_tpu.native.build), StreamDecoder locates all frame boundaries
+in one native call instead of a Python header-parse loop per frame —
+the win for streams of many small frames (beacons, single-command
+client proposes). Semantics are identical; tests/test_native.py checks
+parity, including corrupt-stream latching.
 """
 
 from __future__ import annotations
@@ -22,11 +27,20 @@ import struct
 
 import numpy as np
 
-from minpaxos_tpu.wire.messages import MsgKind, schema
+from minpaxos_tpu import native as _native
+from minpaxos_tpu.wire.messages import SCHEMAS, MsgKind, schema
 
 _HEADER = struct.Struct("<BI")
 HEADER_SIZE = _HEADER.size
 MAX_FRAME_ROWS = 1 << 22  # sanity bound against corrupt streams
+
+# payload row size per opcode for the native scan; 0 = invalid opcode
+_ITEMSIZE = np.zeros(256, np.int32)
+for _k, _dt in SCHEMAS.items():
+    _ITEMSIZE[int(_k)] = _dt.itemsize
+# opcode -> (kind, dtype), avoiding enum construction per frame on the
+# native hot path
+_BY_OP = {int(_k): (_k, _dt) for _k, _dt in SCHEMAS.items()}
 
 
 def encode_frame(kind: MsgKind, rows: np.ndarray) -> bytes:
@@ -92,6 +106,8 @@ class StreamDecoder:
         if self.error is not None:
             raise self.error
         self._buf.extend(chunk)
+        if _native.libnative is not None:
+            return self._feed_native()
         out: list[tuple[MsgKind, np.ndarray]] = []
         pos = 0
         try:
@@ -104,6 +120,28 @@ class StreamDecoder:
             self.error = e
         if pos:
             del self._buf[:pos]
+        return out
+
+    def _feed_native(self) -> list[tuple[MsgKind, np.ndarray]]:
+        """Frame-boundary scan in C, then one frombuffer per frame."""
+        ops, offs, nrows, consumed, corrupt = _native.scan_frames(
+            self._buf, _ITEMSIZE, MAX_FRAME_ROWS)
+        out: list[tuple[MsgKind, np.ndarray]] = []
+        if len(ops):
+            view = bytes(memoryview(self._buf)[:consumed])
+            by_op, frombuffer = _BY_OP, np.frombuffer
+            for op, off, n in zip(ops.tolist(), offs.tolist(),
+                                  nrows.tolist()):
+                kind, dt = by_op[op]
+                out.append((kind, frombuffer(view, dtype=dt, count=n,
+                                             offset=off)))
+        if corrupt:
+            self.error = ValueError(
+                "malformed frame after byte "
+                f"{consumed} (opcode {self._buf[consumed]})"
+                if consumed < len(self._buf) else "malformed frame")
+        if consumed:
+            del self._buf[:consumed]
         return out
 
     def pending_bytes(self) -> int:

@@ -8,7 +8,6 @@ master-driven election + client failover.
 """
 
 import os
-import socket
 import time
 
 import numpy as np
@@ -18,19 +17,7 @@ from minpaxos_tpu.models.minpaxos import MinPaxosConfig
 from minpaxos_tpu.runtime.client import Client, gen_workload
 from minpaxos_tpu.runtime.master import Master, get_leader
 from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
-
-
-def free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
+from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
 
 SMALL = dict(window=1 << 10, inbox=1024, exec_batch=512, kv_pow2=12,
              catchup_rows=64, recovery_rows=64)
@@ -41,11 +28,10 @@ class Harness:
 
     def __init__(self, tmp_path, n=3, durable=False, thrifty=False,
                  classic=False):
-        # data ports must leave room for control ports (+1000)
-        base = free_ports(1)[0]
-        self.ports = free_ports(n + 1)
-        self.mport = self.ports[0]
-        self.addrs = [("127.0.0.1", p) for p in self.ports[1:]]
+        # replica data ports need their +1000 control sibling free too
+        self.mport = free_ports(1)[0]
+        self.addrs = [("127.0.0.1", p) for p in
+                      free_ports(n, sibling_offset=CONTROL_OFFSET)]
         self.master = Master("127.0.0.1", self.mport, n, ping_s=0.3)
         self.master.start()
         # register every replica (the CLI binary's startup step)

@@ -1,0 +1,139 @@
+"""Native (C++) layer: build, scan parity with the Python decoder,
+corrupt-stream latching, cycle clock — and the measured win.
+
+The library is optional everywhere; these tests build it (skipping if
+no g++) and check the native StreamDecoder path is bit-identical to
+the pure-Python one, including the latch-after-partial-results corrupt
+semantics. Counterpart of the reference's rdtsc shim (rdtsc.s:1-8),
+plus the frame scan that replaces codec.py's per-frame header loop.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu import native
+from minpaxos_tpu.native import build as native_build
+from minpaxos_tpu.wire import codec
+from minpaxos_tpu.wire.messages import MsgKind, make_batch
+
+
+@pytest.fixture(scope="module")
+def lib():
+    path = native_build.build(quiet=True)
+    if path is None:
+        pytest.skip("no g++ toolchain")
+    # (re)bind in-process if the module was imported pre-build
+    if native.libnative is None:
+        import importlib
+
+        importlib.reload(native)
+    assert native.libnative is not None
+    return native.libnative
+
+
+def _frames(rng, n):
+    out = []
+    for _ in range(n):
+        pick = rng.integers(0, 3)
+        if pick == 0:
+            out.append(codec.encode_frame(MsgKind.PREPARE, make_batch(
+                MsgKind.PREPARE, leader_id=int(rng.integers(0, 5)),
+                ballot=int(rng.integers(0, 1 << 20)),
+                last_committed=int(rng.integers(-1, 100)))))
+        elif pick == 1:
+            k = int(rng.integers(1, 6))
+            out.append(codec.encode_frame(MsgKind.ACCEPT, make_batch(
+                MsgKind.ACCEPT, inst=np.arange(k), ballot=7, op=1,
+                key=rng.integers(0, 1 << 40, k), val=rng.integers(0, 9, k),
+                cmd_id=np.arange(k), client_id=3, leader_id=0,
+                last_committed=-1)))
+        else:
+            out.append(codec.encode_frame(MsgKind.BEACON, make_batch(
+                MsgKind.BEACON, rid=1,
+                timestamp=int(rng.integers(0, 1 << 60)))))
+    return out
+
+
+def _drain(dec, data, rng):
+    got = []
+    i = 0
+    while i < len(data):
+        step = int(rng.integers(1, 64))
+        got += dec.feed(data[i:i + step])
+        i += step
+    return got
+
+
+def test_scan_parity_random_chunking(lib):
+    rng = np.random.default_rng(0)
+    data = b"".join(_frames(rng, 200))
+    nat = _drain(codec.StreamDecoder(), data, np.random.default_rng(1))
+    pyd = codec.StreamDecoder()
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(codec._native, "libnative", None)
+        py = _drain(pyd, data, np.random.default_rng(1))
+    assert len(nat) == len(py) == 200
+    for (k1, r1), (k2, r2) in zip(nat, py):
+        assert k1 == k2
+        assert r1.tobytes() == r2.tobytes()
+
+
+def test_scan_corrupt_latches_after_partial_results(lib):
+    good = codec.encode_frame(MsgKind.PREPARE, make_batch(
+        MsgKind.PREPARE, leader_id=0, ballot=1, last_committed=-1))
+    for bad in (b"\x00aaaa", b"\xf0aaaa",
+                b"\x01" + (1 << 30).to_bytes(4, "little")):
+        dec = codec.StreamDecoder()
+        got = dec.feed(good + bad)
+        assert len(got) == 1 and got[0][0] == MsgKind.PREPARE
+        assert isinstance(dec.error, ValueError)
+        with pytest.raises(ValueError):
+            dec.feed(b"")
+
+
+def test_scan_empty_and_partial_tail(lib):
+    dec = codec.StreamDecoder()
+    assert dec.feed(b"") == []
+    frame = codec.encode_frame(MsgKind.PREPARE, make_batch(
+        MsgKind.PREPARE, leader_id=0, ballot=9, last_committed=2))
+    assert dec.feed(frame[:3]) == []
+    assert dec.pending_bytes() == 3
+    got = dec.feed(frame[3:])
+    assert len(got) == 1 and got[0][1]["ballot"][0] == 9
+    assert dec.pending_bytes() == 0
+
+
+def test_cputicks_monotonic_and_cheap(lib):
+    t = [lib.mp_cputicks() for _ in range(100)]
+    assert all(b >= a for a, b in zip(t, t[1:]))
+    assert lib.mp_monotonic_ns() > 0
+
+
+def test_scan_speedup_measured(lib):
+    """The win the native layer exists for: many small frames. Prints
+    the measured ratio; asserts only that the native path is not
+    pathologically slower (timing on shared CI is noisy)."""
+    rng = np.random.default_rng(2)
+    data = b"".join(_frames(rng, 50) * 100)  # ~5000 small frames
+
+    def run(native_on):
+        dec = codec.StreamDecoder()
+        with pytest.MonkeyPatch.context() as mp:
+            if not native_on:
+                mp.setattr(codec._native, "libnative", None)
+            t0 = time.perf_counter()
+            n = len(dec.feed(data))
+            dt = time.perf_counter() - t0
+        assert n == 5000
+        return dt
+
+    run(True), run(False)  # warm
+    t_nat = min(run(True) for _ in range(3))
+    t_py = min(run(False) for _ in range(3))
+    print(f"\nnative scan: {t_nat * 1e3:.2f}ms  python: {t_py * 1e3:.2f}ms "
+          f" speedup x{t_py / t_nat:.1f} (5000 frames)")
+    assert t_nat < t_py * 1.5
