@@ -234,7 +234,14 @@ def _side_config(cfg, g, p, k, protocol, dispatches=2):
     }
 
 
-def main() -> None:
+def measure(shape: tuple[int, int, int, int] | None = None) -> None:
+    """One full measurement pass (headline + fault leg + side configs)
+    at the given (g, w, p, k) shape, emitting the JSON record. Runs in
+    a CHILD process under main()'s shape ladder: a too-big shape can
+    crash the remote TPU worker outright (observed: 'TPU worker
+    process crashed or restarted' during the 1M-instance warmup), and
+    a crashed worker poisons the in-process backend — only a fresh
+    process can retry."""
     devices = _init_backend()
     import jax
     import numpy as np
@@ -244,6 +251,11 @@ def main() -> None:
 
     platform = devices[0].platform
     on_tpu = platform not in ("cpu",)
+    if shape is not None and not on_tpu:
+        # the ladder asked for a TPU shape but the backend fell back to
+        # CPU (worker still respawning): fail fast, the driver retries
+        _failure("child", f"backend fell back to {platform}")
+        return
     # g shards x w-slot windows = concurrent instances resident on chip
     # k_dead: rounds the victim stays masked dead (ONE small fused
     # dispatch). Pod-mode healing serves from the leader's retained
@@ -251,7 +263,10 @@ def main() -> None:
     # below it (here 2*512 = 1024 < 2048) or the victim can never
     # reheal on-device (beyond-retention resync is the TCP runtime's
     # stable-store path, exercised in tests/test_distributed.py).
-    if on_tpu:
+    if shape is not None:
+        g, w, p, k = shape
+        healthy_d, k_dead, rec_d = 4, 2, 2
+    elif on_tpu:
         g, w, p, k = 256, 4096, 512, 32  # 1,048,576 concurrent
         healthy_d, k_dead, rec_d = 4, 2, 2
     else:
@@ -261,13 +276,20 @@ def main() -> None:
     # the dead-phase gap is dead_d*k*p slots per shard and catch-up
     # ships catchup_rows/2 per round (most-lagging-peer ticks), so
     # recovery needs ~2*gap/catchup_rows rounds < rec_d*k.
+    # kv_pow2 15 = 32k entries vs the 16k-key workload key_space: 2x
+    # headroom at half the HBM of the former 2^16 tables (the KV is the
+    # dominant allocation — ~0.9 GB saved at g=256)
     cfg = MinPaxosConfig(
         n_replicas=5, window=w, inbox=4 * p + 256, exec_batch=p,
-        kv_pow2=16 if on_tpu else 10,
+        kv_pow2=15 if on_tpu else 10,
         catchup_rows=512 if on_tpu else 128, recovery_rows=64)
     t_boot = time.perf_counter()
     try:
-        sc = ShardedCluster(cfg, g, ext_rows=p)
+        # key_space < KV capacity: the run inserts ~dispatches*k*p
+        # distinct keys per shard otherwise, saturating the table
+        # mid-measurement (kv.dropped) and degenerating probe chains
+        sc = ShardedCluster(cfg, g, ext_rows=p,
+                            key_space=1 << (14 if on_tpu else 8))
         _progress(f"init {time.perf_counter() - t_boot:.1f}s")
         sc.elect(0)
         _progress(f"elect {time.perf_counter() - t_boot:.1f}s")
@@ -284,19 +306,30 @@ def main() -> None:
             sc.run_fused(1, p)  # np.asarray inside blocks until ready
         k1_ms = (time.perf_counter() - t0) / 3 * 1e3
 
+        # -- optional device profile: MP_BENCH_PROFILE=<dir> wraps the
+        # measured phase in a jax.profiler trace so device compute can
+        # be split from tunnel/dispatch tax offline --
+        import contextlib
+        import os as _os
+
+        prof_dir = _os.environ.get("MP_BENCH_PROFILE")
+        prof_cm = (jax.profiler.trace(prof_dir) if prof_dir
+                   else contextlib.nullcontext())
+
         # -- measured phase 1: healthy, healthy_d fused dispatches --
         start_committed, _, _ = sc.committed()
         u0, c0 = shard_cursors(cfg, sc.leader, sc.ss)
         # pre-phase cursor row so round-1 injections aren't censored
         U, C = [np.asarray(u0)[None].copy()], [np.asarray(c0)[None].copy()]
         walls = [time.perf_counter()]
-        for i in range(healthy_d):
-            u, c = sc.run_fused(k, p)
-            U.append(u)
-            C.append(c)
-            walls.append(time.perf_counter())
-            _progress(f"healthy dispatch {i}: "
-                      f"{(walls[-1] - walls[-2]) * 1e3:.0f}ms / {k} rounds")
+        with prof_cm:
+            for i in range(healthy_d):
+                u, c = sc.run_fused(k, p)
+                U.append(u)
+                C.append(c)
+                walls.append(time.perf_counter())
+                _progress(f"healthy dispatch {i}: "
+                          f"{(walls[-1] - walls[-2]) * 1e3:.0f}ms / {k} rounds")
         healthy_wall = walls[-1] - walls[0]
         healthy_rounds = healthy_d * k
         committed_healthy = int((U[-1][-1] + 1).sum()) - start_committed
@@ -459,6 +492,68 @@ def main() -> None:
         _progress(traceback.format_exc())
         _failure("run", repr(e))
         sys.exit(0)
+
+
+def main() -> None:
+    """Shape-ladder driver: run measure() in a child process per
+    attempt, falling back to smaller shapes when the big one crashes
+    the TPU worker or hangs the tunnel (both observed under axon).
+
+    The child prints the JSON record on stdout; the driver relays the
+    LAST stdout line. A child that dies/hangs/lands on an unintended
+    platform triggers the next rung after a recovery pause (the worker
+    takes minutes to come back after a crash)."""
+    import os
+    import subprocess
+
+    if os.environ.get("MP_BENCH_CHILD"):
+        measure(tuple(int(x) for x in
+                      os.environ["MP_BENCH_CHILD"].split(","))
+                if "," in os.environ["MP_BENCH_CHILD"] else None)
+        return
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        measure()  # explicit CPU run: tiny shape, no ladder needed
+        return
+
+    ladder = [
+        (256, 4096, 512, 32),  # 1,048,576 concurrent (north-star)
+        (256, 4096, 512, 8),   # same shape, shorter fused scan
+        (128, 4096, 512, 16),  # 524,288 (round-2 scale)
+        (64, 2048, 256, 16),   # 131,072
+    ]
+    last_fail = "no attempts ran"
+    for i, shape in enumerate(ladder):
+        env = dict(os.environ,
+                   MP_BENCH_CHILD=",".join(str(x) for x in shape))
+        _progress(f"ladder {i}: shape {shape}")
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__], env=env,
+                stdout=subprocess.PIPE, timeout=2400.0)
+        except subprocess.TimeoutExpired:
+            last_fail = f"shape {shape}: child hung > 2400s"
+            _progress(last_fail)
+            continue
+        lines = [ln for ln in proc.stdout.decode().splitlines()
+                 if ln.strip().startswith("{")]
+        if proc.returncode != 0 or not lines:
+            last_fail = f"shape {shape}: child rc={proc.returncode}"
+            _progress(last_fail)
+            time.sleep(120)  # crashed worker: give it time to respawn
+            continue
+        rec = json.loads(lines[-1])
+        if rec.get("error") or rec.get("platform") in ("cpu", "none"):
+            # backend fell back to CPU / run failed inside the child:
+            # retry a smaller rung after recovery (a CPU number must
+            # never masquerade as the TPU headline)
+            last_fail = (f"shape {shape}: "
+                         f"{rec.get('error') or rec.get('platform')}")
+            _progress(last_fail)
+            time.sleep(120)
+            continue
+        print(lines[-1])
+        return
+    _failure("ladder", last_fail)
 
 
 if __name__ == "__main__":

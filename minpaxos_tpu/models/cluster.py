@@ -132,6 +132,35 @@ def pack_reply_key(client_id, cmd_id) -> np.ndarray:
         np.asarray(cmd_id, np.int64) & 0xFFFFFFFF)
 
 
+class KeyBuf:
+    """Append-only packed-key buffer with amortized-doubling growth:
+    O(1) amortized append, zero-copy view for ``np.isin``. (A
+    chunk-list concatenated on read would re-copy the whole proposal
+    history every time a collect follows a propose.) Keys are never
+    pruned: a key must survive its reply so late duplicate executions
+    (e.g. post-recovery replay) still surface as ``duplicate`` entries
+    in the reply log — the safety tests assert on exactly that."""
+
+    __slots__ = ("_arr", "_n")
+
+    def __init__(self) -> None:
+        self._arr = np.empty(256, np.int64)
+        self._n = 0
+
+    def append(self, keys) -> None:
+        keys = np.atleast_1d(keys)
+        need = self._n + len(keys)
+        if need > len(self._arr):
+            arr = np.empty(max(2 * len(self._arr), need), np.int64)
+            arr[: self._n] = self._arr[: self._n]
+            self._arr = arr
+        self._arr[self._n : need] = keys
+        self._n = need
+
+    def view(self) -> np.ndarray:
+        return self._arr[: self._n]
+
+
 def collect_exec_replies(cl, execr: ExecResult, *,
                          drop_skip_fills: bool = False,
                          record_inst: bool = True) -> None:
@@ -157,8 +186,8 @@ def collect_exec_replies(cl, execr: ExecResult, *,
         n = int(counts[rep])
         if not n:
             continue
-        chunks = cl._prop_keys.get(rep)
-        if not chunks:
+        keys = cl._prop_keys.get(rep)
+        if keys is None:
             continue  # nothing ever proposed to this replica
         cid_n, mid_n, op_n = e_cid[rep][:n], e_mid[rep][:n], e_op[rep][:n]
         cand = cid_n >= 0
@@ -166,9 +195,7 @@ def collect_exec_replies(cl, execr: ExecResult, *,
             cand &= ~((op_n == 0) & (mid_n == 0))
         if not cand.any():
             continue
-        if len(chunks) > 1:  # lazy concat, cached
-            cl._prop_keys[rep] = chunks = [np.concatenate(chunks)]
-        cand &= np.isin(pack_reply_key(cid_n, mid_n), chunks[0])
+        cand &= np.isin(pack_reply_key(cid_n, mid_n), keys.view())
         idx = np.nonzero(cand)[0]
         if not idx.size:
             continue
@@ -216,9 +243,9 @@ class Cluster:
         # proposed to replies (reference lb.clientProposals,
         # bareminpaxos.go:75-82); other replicas execute silently
         self._proposed_at: dict[tuple[int, int], int] = {}
-        # packed-key arrays per replica, the vectorized face of
+        # packed-key buffers per replica, the vectorized face of
         # _proposed_at (np.isin prefilter in _collect_exec)
-        self._prop_keys: dict[int, list[np.ndarray]] = {}
+        self._prop_keys: dict[int, KeyBuf] = {}
 
     # -- control plane --
 
@@ -278,7 +305,7 @@ class Cluster:
         )
         for mid in np.asarray(cmd_ids, dtype=np.int64):
             self._proposed_at[(client_id, int(mid))] = to
-        self._prop_keys.setdefault(to, []).append(
+        self._prop_keys.setdefault(to, KeyBuf()).append(
             pack_reply_key(client_id, cmd_ids))
         batch = MsgBatch(**{f: row[f] for f in MsgBatch._fields})
         for lo in range(0, n, self.ext_rows):

@@ -769,7 +769,7 @@ class MenciusCluster:
         self.replies: dict[tuple[int, int], dict] = {}
         self.reply_log: list[dict] = []
         self._proposed_at: dict[tuple[int, int], int] = {}
-        self._prop_keys: dict[int, list[np.ndarray]] = {}
+        self._prop_keys: dict[int, object] = {}  # rep -> cluster.KeyBuf
 
     def kill(self, replica: int) -> None:
         self.cs = self.cs._replace(alive=self.cs.alive.at[replica].set(False))
@@ -800,9 +800,9 @@ class MenciusCluster:
         )
         for mid in np.asarray(cmd_ids, dtype=np.int64):
             self._proposed_at[(client_id, int(mid))] = to
-        from minpaxos_tpu.models.cluster import pack_reply_key
+        from minpaxos_tpu.models.cluster import KeyBuf, pack_reply_key
 
-        self._prop_keys.setdefault(to, []).append(
+        self._prop_keys.setdefault(to, KeyBuf()).append(
             pack_reply_key(client_id, cmd_ids))
         batch = MsgBatch(**{f: row[f] for f in MsgBatch._fields})
         for lo in range(0, n, self.ext_rows):

@@ -42,6 +42,15 @@ def build(force: bool = False, quiet: bool = False) -> str | None:
         raise RuntimeError(f"g++ failed (rc={proc.returncode})")
     # atomic publish: concurrent builders (pytest workers) race safely
     os.replace(tmp, OUT)
+    # rebind the already-imported package (importing THIS module imported
+    # minpaxos_tpu.native, which bound libnative=None when the .so was
+    # absent) — otherwise the building process itself never gets the
+    # fast path it just compiled
+    import importlib
+
+    import minpaxos_tpu.native
+
+    importlib.reload(minpaxos_tpu.native)
     return OUT
 
 

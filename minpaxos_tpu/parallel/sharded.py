@@ -164,11 +164,11 @@ def make_propose_ext(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 8),
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 8, 9),
                    donate_argnums=4)
 def sharded_run(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
                 k_rounds: int, ss: ClusterState, n_proposals, leader, seed0,
-                step_impl=None):
+                step_impl=None, key_space: int = 1 << 20):
     """k protocol rounds in ONE dispatch via ``lax.scan``.
 
     The per-round host round-trip (dispatch + cursor reads) dominated
@@ -188,7 +188,7 @@ def sharded_run(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
 
     def body(ss, t):
         ext = make_propose_ext(cfg, n_shards, ext_rows, n_proposals,
-                               leader, seed0 + t)
+                               leader, seed0 + t, key_space)
         ss, _, _, _ = jax.vmap(
             functools.partial(cluster_step_impl, cfg, step_impl=step))(
             ss, ext)
@@ -234,12 +234,19 @@ class ShardedCluster:
     Cluster but with everything hot staying on device."""
 
     def __init__(self, cfg: MinPaxosConfig, n_shards: int,
-                 ext_rows: int = 512, mesh=None, protocol: str = "minpaxos"):
+                 ext_rows: int = 512, mesh=None, protocol: str = "minpaxos",
+                 key_space: int = 1 << 20):
         self.cfg = cfg
         self.n_shards = n_shards
         self.ext_rows = ext_rows
         self.mesh = mesh
         self.protocol = protocol
+        # distinct keys per shard the device workload draws from; keep
+        # below the KV capacity (1 << cfg.kv_pow2) or long benches
+        # saturate the table (kv.dropped) and probe chains degenerate —
+        # the reference's clients likewise reuse a bounded key array
+        # (client.go:68-103 karray)
+        self.key_space = key_space
         if protocol == "mencius":
             from minpaxos_tpu.models.mencius import (
                 init_mencius,
@@ -266,7 +273,7 @@ class ShardedCluster:
         ext = make_propose_ext(
             self.cfg, self.n_shards, self.ext_rows,
             jnp.int32(min(n_proposals, self.ext_rows)),
-            jnp.int32(self.leader), jnp.int32(self._seed))
+            jnp.int32(self.leader), jnp.int32(self._seed), self.key_space)
         if self.mesh is not None:
             ext = jax.tree_util.tree_map(
                 lambda x: jax.lax.with_sharding_constraint(
@@ -286,7 +293,7 @@ class ShardedCluster:
             self.cfg, self.n_shards, self.ext_rows, k_rounds, self.ss,
             jnp.int32(min(n_proposals, self.ext_rows)),
             jnp.int32(self.leader), jnp.int32(self._seed),
-            self._step_impl)
+            self._step_impl, self.key_space)
         self._seed += k_rounds
         return np.asarray(uptos), np.asarray(crts)
 

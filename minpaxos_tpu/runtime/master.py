@@ -25,6 +25,7 @@ import threading
 import time
 
 from minpaxos_tpu.utils.dlog import dlog
+from minpaxos_tpu.utils.netutil import CONTROL_OFFSET
 
 
 def _rpc(addr: tuple[str, int], req: dict, timeout: float = 2.0) -> dict:
@@ -142,7 +143,7 @@ class Master:
                 continue
             for rid, (host, port) in nodes:
                 try:
-                    resp = _rpc((host, port + 1000), {"m": "ping"},
+                    resp = _rpc((host, port + CONTROL_OFFSET), {"m": "ping"},
                                 timeout=1.0)
                     ok = bool(resp.get("ok"))
                     fr = int(resp.get("frontier", -1))
@@ -173,7 +174,7 @@ class Master:
             # stays false forever); on failure the next ping round
             # re-elects
             try:
-                _rpc((host, port + 1000), {"m": "be_the_leader"}, timeout=2.0)
+                _rpc((host, port + CONTROL_OFFSET), {"m": "be_the_leader"}, timeout=2.0)
             except (OSError, json.JSONDecodeError):
                 continue
             with self._lock:

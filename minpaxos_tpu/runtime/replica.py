@@ -50,6 +50,7 @@ from minpaxos_tpu.runtime.transport import (
 )
 from minpaxos_tpu.utils.clock import cputicks, monotonic_ns
 from minpaxos_tpu.utils.dlog import dlog
+from minpaxos_tpu.utils.netutil import CONTROL_OFFSET
 from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
 
 CONTROL = 3  # queue item source tag (transport uses 0..2)
@@ -208,7 +209,7 @@ class ReplicaServer:
         deadline = time.monotonic() + 10.0
         while True:
             try:
-                s.bind((host, port + 1000))
+                s.bind((host, port + CONTROL_OFFSET))
                 break
             except OSError:
                 if time.monotonic() > deadline:
