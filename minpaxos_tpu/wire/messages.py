@@ -58,6 +58,8 @@ class MsgKind(enum.IntEnum):
     PROPOSE_REPLY = 2
     READ = 3
     READ_REPLY = 4
+    # declared for wire parity; dead in the reference too (its handler
+    # parses then drops the message, genericsmr.go:478-483)
     PROPOSE_AND_READ = 5
     PROPOSE_AND_READ_REPLY = 6
     BEACON = 7
