@@ -189,7 +189,10 @@ def _side_config(cfg, g, p, k, protocol, dispatches=2):
 
     from minpaxos_tpu.parallel.sharded import ShardedCluster, shard_cursors
 
-    sc = ShardedCluster(cfg, g, ext_rows=max(p, 1), protocol=protocol)
+    # key_space at half KV capacity: same saturation guard as the
+    # headline (long runs would otherwise fill the table mid-measure)
+    sc = ShardedCluster(cfg, g, ext_rows=max(p, 1), protocol=protocol,
+                        key_space=1 << (cfg.kv_pow2 - 1))
     if protocol != "mencius":
         sc.elect(0)
     sc.run_fused(k, p)  # compile + warm
