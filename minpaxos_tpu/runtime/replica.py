@@ -158,8 +158,7 @@ class ReplicaServer:
         getDataFromStableStore (bareminpaxos.go:122-161) rebuilt Go
         structs; here recovery IS the protocol."""
         frontier = self.store.committed_prefix()
-        max_ballot = max((int(r["ballot"]) for r in self.store.slots.values()),
-                         default=0)
+        max_ballot = self.store.max_ballot()
         chunk = self.cfg.exec_batch
         for lo in range(0, frontier + 1, chunk):
             rec = self.store.read_range(lo, min(lo + chunk, frontier + 1) - 1)

@@ -15,8 +15,9 @@ boot (getDataFromStableStore :122-161). Two deliberate upgrades:
   beyond-window catch-up from its own log (models/minpaxos.py window
   slide LIMIT note).
 
-The in-memory mirror (``self.slots``) doubles as the leader's
-beyond-retention resync source: reads never touch disk.
+The in-memory mirror (a dense growable structured array — log slots
+are dense integers) doubles as the leader's beyond-retention resync
+source: reads never touch disk.
 """
 
 from __future__ import annotations
@@ -54,7 +55,14 @@ class StableStore:
         self.path = path
         self.sync = sync
         existed = os.path.exists(path) and os.path.getsize(path) > len(MAGIC)
-        self.slots: dict[int, np.void] = {}
+        # mirror: log slots are DENSE integers, so the in-memory mirror
+        # is a growable structured array + presence mask (34 B/slot,
+        # vectorized update/read) rather than a dict of numpy scalars —
+        # the per-row dict/.copy() loop was the hottest host path in a
+        # tick profile
+        self._mirror = np.zeros(0, SLOT_DT)
+        self._have = np.zeros(0, bool)
+        self._max_inst = -1
         # insts recorded with status >= COMMITTED: commitment is final,
         # so re-appends of these slots are pure log amplification and
         # the runtime's _persist drops them (heal sweeps deliver R-1
@@ -76,9 +84,55 @@ class StableStore:
 
     @property
     def recovered(self) -> bool:
-        return bool(self.slots) or self.frontier >= 0
+        return self._max_inst >= 0 or self.frontier >= 0
 
     # -- append --
+
+    def _ensure(self, upto: int) -> None:
+        if upto < len(self._mirror):
+            return
+        cap = max(1024, 2 * len(self._mirror), upto + 1)
+        mirror = np.zeros(cap, SLOT_DT)
+        mirror[: len(self._mirror)] = self._mirror
+        have = np.zeros(cap, bool)
+        have[: len(self._have)] = self._have
+        self._mirror, self._have = mirror, have
+
+    def _update_mirror(self, rec: np.ndarray) -> None:
+        """Apply one record batch to the mirror (ballot supersede)."""
+        insts = rec["inst"].astype(np.int64)
+        self._ensure(int(insts.max()))
+        if len(np.unique(insts)) != len(insts):
+            # same slot twice in one batch (e.g. ACCEPT + COMMIT in one
+            # tick): supersede must see earlier rows' writes — rare, so
+            # sequential
+            for j in range(len(rec)):
+                i = int(insts[j])
+                if (not self._have[i]
+                        or rec["ballot"][j] >= self._mirror["ballot"][i]):
+                    self._mirror[i] = rec[j]
+                    self._have[i] = True
+        else:
+            old_ballot = np.where(self._have[insts],
+                                  self._mirror["ballot"][insts], -(2 ** 31))
+            take = rec["ballot"] >= old_ballot
+            self._mirror[insts[take]] = rec[take]
+            self._have[insts[take]] = True
+        self._max_inst = max(self._max_inst, int(insts.max()))
+        cm = insts[rec["status"] >= _COMMITTED]
+        if cm.size:
+            self.committed.update(cm.tolist())
+            self._committed_arr = None
+        # advance the contiguous prefix in one scan of the newly
+        # covered region (amortized O(1) per slot over the log's life);
+        # bound the scan at _max_inst — everything past it is False, so
+        # scanning the full doubled capacity would make this O(cap)
+        start = self._contig + 1
+        end = self._max_inst + 2
+        if start < len(self._have) and self._have[start]:
+            gap = np.nonzero(~self._have[start:end])[0]
+            self._contig = (start + int(gap[0]) - 1) if gap.size else (
+                self._max_inst)
 
     def append_slots(self, inst, ballot, status, op, key, val, cmd_id,
                      client_id) -> None:
@@ -92,16 +146,7 @@ class StableStore:
         payload = rec.tobytes()
         self._f.write(_HDR.pack(REC_SLOTS, len(payload)))
         self._f.write(payload)
-        for r in rec:
-            i = int(r["inst"])
-            old = self.slots.get(i)
-            if old is None or int(r["ballot"]) >= int(old["ballot"]):
-                self.slots[i] = r.copy()
-            if int(r["status"]) >= _COMMITTED:
-                self.committed.add(i)
-                self._committed_arr = None
-        while (self._contig + 1) in self.slots:
-            self._contig += 1
+        self._update_mirror(rec)
 
     def append_frontier(self, committed_upto: int) -> None:
         if committed_upto <= self.frontier:
@@ -144,21 +189,13 @@ class StableStore:
             if pos + plen > len(data):
                 break  # torn tail write (crash mid-append): ignore
             if rtype == REC_SLOTS and plen % SLOT_DT.itemsize == 0:
-                rec = np.frombuffer(data, SLOT_DT, plen // SLOT_DT.itemsize,
-                                    pos)
-                for r in rec:
-                    i = int(r["inst"])
-                    old = self.slots.get(i)
-                    if old is None or int(r["ballot"]) >= int(old["ballot"]):
-                        self.slots[i] = r.copy()
-                    if int(r["status"]) >= _COMMITTED:
-                        self.committed.add(i)
+                n = plen // SLOT_DT.itemsize
+                if n:
+                    self._update_mirror(np.frombuffer(data, SLOT_DT, n, pos))
             elif rtype == REC_FRONTIER and plen == _FRONTIER.size:
                 (fr,) = _FRONTIER.unpack_from(data, pos)
                 self.frontier = max(self.frontier, fr)
             pos += plen
-        while (self._contig + 1) in self.slots:
-            self._contig += 1
         covered = min(self._contig, self.frontier)
         self.committed = {i for i in self.committed if i > covered}
 
@@ -189,11 +226,20 @@ class StableStore:
 
     def read_range(self, lo: int, hi: int) -> np.ndarray:
         """Slot records for inst in [lo, hi] that exist, ascending —
-        the leader's beyond-window catch-up source."""
-        out = [self.slots[i] for i in range(lo, hi + 1) if i in self.slots]
-        if not out:
+        the leader's beyond-window catch-up source. One mirror slice."""
+        lo = max(lo, 0)
+        hi = min(hi, len(self._mirror) - 1)
+        if hi < lo:
             return np.zeros(0, SLOT_DT)
-        return np.array(out, dtype=SLOT_DT)
+        sl = slice(lo, hi + 1)
+        return self._mirror[sl][self._have[sl]]  # mask index = fresh array
 
     def max_inst(self) -> int:
-        return max(self.slots) if self.slots else -1
+        return self._max_inst
+
+    def max_ballot(self) -> int:
+        """Highest ballot among recorded slots (recovery's promise
+        restore, bareminpaxos.go:383-385)."""
+        if self._max_inst < 0:
+            return 0
+        return int(self._mirror["ballot"][self._have].max(initial=0))
