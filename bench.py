@@ -64,6 +64,52 @@ def _failure(stage: str, err: str) -> None:
     })
 
 
+def _probe_backend(timeout_s: float = 120.0) -> str | None:
+    """Subprocess probe: the default backend's platform name, or None
+    if init fails/hangs. Popen + DEVNULL + process-group kill, NOT
+    subprocess.run with capture_output: a hung backend init can leave
+    grandchildren (tunnel helpers) holding the output pipes, and
+    run()'s post-kill communicate() then blocks forever. A probe
+    subprocess can't poison this process's backend lock."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".probe") as tf:
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax, pathlib; pathlib.Path("
+             f"{tf.name!r}).write_text(jax.devices()[0].platform)"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        try:
+            rc = p.wait(timeout=timeout_s)
+            platform = tf.read().strip()
+            return platform if rc == 0 and platform else None
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+            return None
+
+
+def _devices_watchdogged(jax, fail_msg: str, timeout_s: float):
+    """In-process jax.devices() under a watchdog thread: a wedged
+    tunnel hangs init while HOLDING the global backend lock, and the
+    only honest outcome then is a structured failure record."""
+    box: list = []
+    t = threading.Thread(target=lambda: box.append(jax.devices()),
+                         daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if not box:
+        _failure("backend-init", fail_msg)
+        sys.exit(0)
+    return box[0]
+
+
 def _init_backend(retries: int = 2, timeout_s: float = 120.0):
     """Initialize a JAX backend defensively. The tunnel's TPU backend
     can hang on init *holding the global backend lock* — once that
@@ -72,7 +118,6 @@ def _init_backend(retries: int = 2, timeout_s: float = 120.0):
     in-process backend is only initialized down a path the probe proved
     alive, else the CPU platform is pinned before any backend touch."""
     import os
-    import subprocess
 
     import jax
 
@@ -85,39 +130,22 @@ def _init_backend(retries: int = 2, timeout_s: float = 120.0):
             jax.config.update("jax_platforms", want)
         except Exception:
             pass
-        return jax.devices()
+        if want.startswith("cpu"):
+            return jax.devices()
+        # explicit non-cpu platform (the tunnel env exports
+        # JAX_PLATFORMS=axon): watchdogged so the ladder driver gets a
+        # fast structured failure instead of burning the child timeout
+        return _devices_watchdogged(jax, f"{want} init hung",
+                                    timeout_s + 60)
 
     ok = False
-    import signal
-    import tempfile
-
     for attempt in range(retries):
-        # Popen + DEVNULL + process-group kill, NOT subprocess.run with
-        # capture_output: a hung backend init can leave grandchildren
-        # (tunnel helpers) holding the output pipes, and run()'s
-        # post-kill communicate() then blocks forever
-        with tempfile.NamedTemporaryFile("r", suffix=".probe") as tf:
-            p = subprocess.Popen(
-                [sys.executable, "-c",
-                 "import jax, pathlib; pathlib.Path("
-                 f"{tf.name!r}).write_text(jax.devices()[0].platform)"],
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-                start_new_session=True)
-            try:
-                rc = p.wait(timeout=timeout_s)
-                platform = tf.read().strip()
-                if rc == 0 and platform:
-                    _progress(f"probe: default backend alive ({platform})")
-                    ok = True
-                    break
-                _progress(f"probe attempt {attempt}: rc={rc}")
-            except subprocess.TimeoutExpired:
-                _progress(f"probe attempt {attempt}: hung > {timeout_s}s")
-                try:
-                    import os as _os
-                    _os.killpg(_os.getpgid(p.pid), signal.SIGKILL)
-                except (OSError, ProcessLookupError):
-                    pass
+        platform = _probe_backend(timeout_s)
+        if platform:
+            _progress(f"probe: default backend alive ({platform})")
+            ok = True
+            break
+        _progress(f"probe attempt {attempt}: dead/hung")
         time.sleep(2.0)
 
     if not ok:
@@ -129,18 +157,8 @@ def _init_backend(retries: int = 2, timeout_s: float = 120.0):
             sys.exit(0)
         return jax.devices()
 
-    # probe said alive — still guard the in-process init with a
-    # watchdog; if it hangs anyway the lock is poisoned and the only
-    # honest outcome is a structured failure record
-    result: list = []
-    t = threading.Thread(target=lambda: result.append(jax.devices()),
-                         daemon=True)
-    t.start()
-    t.join(timeout=timeout_s + 60)
-    if not result:
-        _failure("backend-init", "in-process init hung after live probe")
-        sys.exit(0)
-    return result[0]
+    return _devices_watchdogged(
+        jax, "in-process init hung after live probe", timeout_s + 60)
 
 
 def _latency_rounds(uptos, crts, round_ms):
@@ -526,6 +544,20 @@ def main() -> None:
     ]
     last_fail = "no attempts ran"
     for i, shape in enumerate(ladder):
+        # wait for a live non-cpu backend before burning a child
+        # attempt — a crashed worker takes minutes to respawn (or
+        # doesn't); a probe costs 2 min vs a child's full timeout
+        for attempt in range(5):
+            alive = _probe_backend()
+            if alive and alive != "cpu":
+                break
+            _progress(f"backend probe dead ({attempt})")
+            if attempt < 4:
+                time.sleep(120)
+        else:
+            last_fail = "backend unreachable after 5 probes"
+            _progress(last_fail)
+            break
         env = dict(os.environ,
                    MP_BENCH_CHILD=",".join(str(x) for x in shape))
         _progress(f"ladder {i}: shape {shape}")
