@@ -138,6 +138,13 @@ def _init_backend(retries: int = 2, timeout_s: float = 120.0):
         return _devices_watchdogged(jax, f"{want} init hung",
                                     timeout_s + 60)
 
+    if os.environ.get("MP_BENCH_PROBED"):
+        # the ladder driver probed this backend seconds ago; skip the
+        # redundant subprocess init (expensive over the tunnel) and go
+        # straight to the watchdogged in-process init
+        return _devices_watchdogged(
+            jax, "init hung after driver probe", timeout_s + 60)
+
     ok = False
     for attempt in range(retries):
         platform = _probe_backend(timeout_s)
@@ -546,20 +553,24 @@ def main() -> None:
     for i, shape in enumerate(ladder):
         # wait for a live non-cpu backend before burning a child
         # attempt — a crashed worker takes minutes to respawn (or
-        # doesn't); a probe costs 2 min vs a child's full timeout
+        # doesn't). Worst case this gate costs ~12 min (5 probes that
+        # each hang their 120s timeout, plus inter-probe sleeps only
+        # after fast failures) vs a child's 40-min timeout.
         for attempt in range(5):
+            t_probe = time.monotonic()
             alive = _probe_backend()
             if alive and alive != "cpu":
                 break
             _progress(f"backend probe dead ({attempt})")
-            if attempt < 4:
-                time.sleep(120)
+            if attempt < 4 and time.monotonic() - t_probe < 110:
+                time.sleep(120)  # fast failure: wait out the respawn
         else:
             last_fail = "backend unreachable after 5 probes"
             _progress(last_fail)
             break
         env = dict(os.environ,
-                   MP_BENCH_CHILD=",".join(str(x) for x in shape))
+                   MP_BENCH_CHILD=",".join(str(x) for x in shape),
+                   MP_BENCH_PROBED="1")
         _progress(f"ladder {i}: shape {shape}")
         try:
             proc = subprocess.run(

@@ -8,8 +8,14 @@ are array axes laid over a device mesh:
   instances; the north-star 1024-shard config, BASELINE.md);
 * ``replica`` — the R replicas of one group (quorum communication
   becomes XLA collectives over ICI instead of TCP).
+
+Multi-host: ``multihost.py`` joins processes into one SPMD job and
+builds the global mesh (shard axis across pod slices — zero
+cross-shard collectives, so nothing rides DCN); the replica axis can
+instead span hosts via the TCP runtime when failure domains matter.
 """
 
+from minpaxos_tpu.parallel import multihost
 from minpaxos_tpu.parallel.mesh import make_mesh, shard_leading
 from minpaxos_tpu.parallel.sharded import (
     ShardedCluster,
@@ -18,6 +24,7 @@ from minpaxos_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "multihost",
     "make_mesh",
     "shard_leading",
     "ShardedCluster",

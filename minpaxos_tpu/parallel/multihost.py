@@ -1,0 +1,77 @@
+"""Multi-host deployment glue: one SPMD program over pod slices + DCN.
+
+The reference scales across hosts by running N OS processes joined by
+its hand-rolled TCP mesh (genericsmr.go:125-172). This framework has
+TWO multi-host paths, used for different axes:
+
+* **Replica axis across failure domains** — the TCP runtime
+  (runtime/transport.py) already spans hosts: replicas dial real
+  addresses, so placing the N replicas of a group on N machines is
+  deployment configuration, not new code. This is the fault-tolerance
+  axis; it must NOT share hardware, so it rides commodity TCP exactly
+  like the reference.
+* **Shard axis across pod slices** — the throughput axis. G consensus
+  groups are embarrassingly parallel (no cross-shard traffic in
+  ``parallel/sharded.py``), so scaling G across hosts is standard JAX
+  multi-controller SPMD: every host runs the same fused
+  ``sharded_run`` dispatch, the mesh spans all hosts' devices, and
+  XLA keeps shard-local work on-chip (there are no cross-shard
+  collectives to ride DCN at all — the ideal multi-host workload).
+
+This module is the second path's boilerplate. It is deliberately thin:
+after ``initialize()``, ``jax.devices()`` is the global device list
+and ``make_mesh`` (parallel/mesh.py) already builds the right mesh
+from it.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from minpaxos_tpu.parallel.mesh import make_mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join this process into a multi-controller JAX job.
+
+    No-op when nothing marks this a multi-process job (num_processes
+    in (None, 1) and no coordinator given) so the same launcher script
+    works on a laptop, one pod slice, or many. Passing a
+    coordinator_address with num_processes=None opts into
+    jax.distributed's pod autodetection.
+    """
+    if coordinator_address is None and num_processes in (None, 1):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def global_shard_mesh(n_replica_devices: int = 1):
+    """A ('shard', 'replica') mesh over EVERY process's devices.
+
+    Call after initialize(). Per-host shard counts follow from
+    mesh.shape['shard'] / jax.process_count(); with born-sharded init
+    (parallel/sharded.py init_sharded) each host materializes only its
+    addressable slice — no host ever holds the global state.
+    """
+    return make_mesh(n_replica_devices=n_replica_devices)
+
+
+def process_shard_slice(n_shards: int) -> slice:
+    """The contiguous [lo, hi) shard range this process owns under the
+    default mesh layout (device-major order == process-major order).
+
+    n_shards must divide evenly — it already must for the shard axis
+    to lay out over process_count x local_devices at all, so a
+    remainder here is a config error, not a case to paper over."""
+    n_proc = jax.process_count()
+    if n_shards % n_proc:
+        raise ValueError(
+            f"n_shards={n_shards} not divisible by {n_proc} processes")
+    per = n_shards // n_proc
+    return slice(per * jax.process_index(),
+                 per * (jax.process_index() + 1))

@@ -122,3 +122,21 @@ def test_fused_run_bounded_keyspace_never_drops_kv_inserts():
     # the table really churned overwrite-heavy without dropping
     tot, lo, hi = sc.committed()
     assert lo + 1 > 2 * (1 << (SMALL.kv_pow2 - 1)), (tot, lo, hi)
+
+
+def test_multihost_glue_single_process_degenerate():
+    """Single-process: initialize() no-ops, the global mesh covers all
+    local devices, and the process shard slice is the whole range —
+    the same launcher path that multi-controller jobs take."""
+    from minpaxos_tpu.parallel import multihost
+
+    multihost.initialize(num_processes=1)  # must not raise / contact anyone
+    mesh = multihost.global_shard_mesh()
+    assert mesh.devices.size == len(jax.devices())
+    assert multihost.process_shard_slice(16) == slice(0, 16)
+    # the mesh drives a real sharded cluster end-to-end
+    sc = ShardedCluster(SMALL, 16, ext_rows=64, mesh=mesh)
+    sc.elect(0)
+    sc.run_fused(4, 16)
+    tot, _, _ = sc.committed()
+    assert tot > 0
