@@ -290,3 +290,21 @@ def test_master_elects_highest_frontier(harness):
     assert stats["acked"] == 100, stats
     assert stats["duplicates"] == 0
     cli.close_conn()
+
+
+def test_data_plane_survives_master_death(harness):
+    """masterkill.sh: the master is control-plane only — killing it
+    must not interrupt committed writes for already-connected clients
+    (reference masterkill.sh kills port 7087 and nothing else)."""
+    h = harness()
+    cli = h.client()
+    ops, keys, vals = gen_workload(300, seed=9)
+    assert cli.run_workload(ops[:100], keys[:100], vals[:100],
+                            timeout_s=30)["acked"] == 100
+    h.master.stop()  # data plane must not notice
+    cli.replies.clear()
+    stats = cli.run_workload(ops[100:], keys[100:], vals[100:],
+                             timeout_s=30)
+    assert stats["acked"] == 200, stats
+    assert stats["duplicates"] == 0
+    cli.close_conn()
