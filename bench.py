@@ -52,7 +52,7 @@ def _emit(result: dict) -> None:
     print(json.dumps(result))
 
 
-def _failure(stage: str, err: str) -> None:
+def _failure(stage: str, err: str, **extra) -> None:
     _emit({
         "metric": "committed_instances_per_sec",
         "value": 0.0,
@@ -61,6 +61,7 @@ def _failure(stage: str, err: str) -> None:
         "error": f"{stage}: {err[:500]}",
         "platform": "none",
         "baseline": "north-star 12.5e6 inst/s/chip",
+        **extra,
     })
 
 
@@ -599,7 +600,27 @@ def main() -> None:
             continue
         print(lines[-1])
         return
-    _failure("ladder", last_fail)
+
+    # Every rung failed (wedged tunnel / repeated worker crashes). The
+    # headline is honestly zero — but run the virtual-CPU-mesh config
+    # in a child and attach it as a clearly-labeled reference so the
+    # round still records that the measurement harness itself works.
+    _progress("all rungs failed; capturing cpu-mesh reference record")
+    cpu_ref = None
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        env.pop("MP_BENCH_CHILD", None)
+        proc = subprocess.run([sys.executable, __file__], env=env,
+                              stdout=subprocess.PIPE, timeout=1800.0)
+        lines = [ln for ln in proc.stdout.decode().splitlines()
+                 if ln.strip().startswith("{")]
+        if proc.returncode == 0 and lines:
+            cpu_ref = json.loads(lines[-1])
+    except Exception as e:  # noqa: BLE001 — best-effort reference only
+        _progress(f"cpu reference failed too: {e!r}")
+    _failure("ladder", last_fail,
+             cpu_mesh_reference_NOT_the_headline=cpu_ref)
 
 
 if __name__ == "__main__":

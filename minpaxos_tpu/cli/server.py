@@ -81,15 +81,12 @@ def main(argv=None) -> None:
         exec_batch=args.inbox, kv_pow2=16,
         catchup_rows=256, recovery_rows=256,
         explicit_commit=args.classic)
+    prof = cProfile.Profile() if args.cpuprofile else None
     flags = RuntimeFlags(dreply=args.dreply,
                          durable=args.durable, thrifty=args.thrifty,
-                         beacon=args.beacon, store_dir=args.storedir)
+                         beacon=args.beacon, store_dir=args.storedir,
+                         profile=prof)
     server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags)
-
-    prof = None
-    if args.cpuprofile:
-        prof = cProfile.Profile()
-        prof.enable()
 
     server.start()
     print(f"server: replica {my_id} serving on {args.addr}:{args.port}",
@@ -100,11 +97,15 @@ def main(argv=None) -> None:
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     while not stop:
         time.sleep(0.2)
+    joined = server.stop()  # joins the protocol thread
     if prof is not None:
-        prof.disable()
-        prof.dump_stats(args.cpuprofile)
-        print(f"server: profile written to {args.cpuprofile}", flush=True)
-    server.stop()
+        if joined:  # else the profiler is still live on that thread
+            prof.dump_stats(args.cpuprofile)
+            print(f"server: profile written to {args.cpuprofile}",
+                  flush=True)
+        else:
+            print("server: protocol thread did not join; profile NOT "
+                  "written", flush=True)
     sys.exit(0)
 
 

@@ -74,6 +74,11 @@ class RuntimeFlags:
     beacon: bool = False   # -beacon: RTT beacons -> preferred quorum
     tick_s: float = 0.002  # protocol tick (reference clock: 5ms)
     store_dir: str = "."
+    # -cpuprofile: a cProfile.Profile the PROTOCOL THREAD enables on
+    # start (cProfile is per-thread; enabling it on the main thread —
+    # the obvious wiring — would profile an idle sleep loop and dump
+    # nothing, while all the work happens here)
+    profile: object | None = None
 
 
 class ReplicaServer:
@@ -133,13 +138,18 @@ class ReplicaServer:
         if self.flags.beacon:
             threading.Thread(target=self._beacon_loop, daemon=True).start()
 
-    def stop(self) -> None:
+    def stop(self) -> bool:
+        """Returns True when the protocol thread joined cleanly; False
+        if it was still running at the join timeout (callers that dump
+        its profiler state must not trust the data then)."""
         # order matters: signal, JOIN the protocol thread (it may be
         # mid-_persist), and only then close the store — the reference's
         # single event-loop goroutine gets this for free
         self._stop.set()
+        joined = True
         if self._proto_thread is not None:
             self._proto_thread.join(timeout=10.0)
+            joined = not self._proto_thread.is_alive()
         self.transport.stop()
         if self._ctl_sock is not None:
             try:
@@ -147,6 +157,7 @@ class ReplicaServer:
             except OSError:
                 pass
         self.store.close()
+        return joined
 
     # ---------------- recovery (stable-store replay) ----------------
 
@@ -271,14 +282,21 @@ class ReplicaServer:
     # ---------------- the protocol loop ----------------
 
     def _run(self) -> None:
-        if not self._recovered and self.me == 0:
-            # initial boot: replica 0 self-elects
-            # (bareminpaxos.go:286-290); wait until the mesh is up so
-            # the PREPARE reaches everyone
-            self._wait_for_peers()
-            self.queue.put((CONTROL, 0, "be_the_leader", None))
-        while not self._stop.is_set():
-            self._tick()
+        prof = self.flags.profile
+        if prof is not None:
+            prof.enable()
+        try:
+            if not self._recovered and self.me == 0:
+                # initial boot: replica 0 self-elects
+                # (bareminpaxos.go:286-290); wait until the mesh is up
+                # so the PREPARE reaches everyone
+                self._wait_for_peers()
+                self.queue.put((CONTROL, 0, "be_the_leader", None))
+            while not self._stop.is_set():
+                self._tick()
+        finally:
+            if prof is not None:
+                prof.disable()
 
     def _wait_for_peers(self, timeout_s: float = 15.0) -> None:
         deadline = time.monotonic() + timeout_s

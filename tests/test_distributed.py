@@ -27,7 +27,7 @@ class Harness:
     """Boot master + N replicas on fresh localhost ports."""
 
     def __init__(self, tmp_path, n=3, durable=False, thrifty=False,
-                 classic=False):
+                 classic=False, flags_overrides=None):
         # replica data ports need their +1000 control sibling free too
         self.mport = free_ports(1)[0]
         self.addrs = [("127.0.0.1", p) for p in
@@ -41,9 +41,10 @@ class Harness:
                                  timeout_s=5.0)
         self.cfg = MinPaxosConfig(n_replicas=n, explicit_commit=classic,
                                   **SMALL)
-        self.flags = lambda: RuntimeFlags(
+        overrides = flags_overrides or {}  # per-replica RuntimeFlags kwargs
+        self.flags = lambda i: RuntimeFlags(
             durable=durable, thrifty=thrifty, store_dir=str(tmp_path),
-            tick_s=0.001)
+            tick_s=0.001, **overrides.get(i, {}))
         self.servers: dict[int, ReplicaServer] = {}
         for i in range(n):
             self.start_replica(i)
@@ -57,7 +58,7 @@ class Harness:
             time.sleep(0.05)
 
     def start_replica(self, i) -> None:
-        s = ReplicaServer(i, self.addrs, self.cfg, self.flags())
+        s = ReplicaServer(i, self.addrs, self.cfg, self.flags(i))
         s.start()
         self.servers[i] = s
 
@@ -307,4 +308,24 @@ def test_data_plane_survives_master_death(harness):
                              timeout_s=30)
     assert stats["acked"] == 200, stats
     assert stats["duplicates"] == 0
+    cli.close_conn()
+
+
+def test_cpuprofile_captures_protocol_thread(harness):
+    """-cpuprofile parity (server.go:41-51 pprof): cProfile is
+    per-thread, so the PROTOCOL thread must enable it — wired on the
+    main thread it would profile an idle sleep loop and dump nothing."""
+    import cProfile
+    import pstats
+
+    prof = cProfile.Profile()
+    h = harness(flags_overrides={0: {"profile": prof}})
+    cli = h.client(check=False)
+    ops, keys, vals = gen_workload(50, seed=11)
+    assert cli.run_workload(ops, keys, vals, timeout_s=60)["acked"] == 50
+    assert h.servers[0].stop(), "protocol thread must join"
+    h.servers.pop(0)
+    stats = pstats.Stats(prof)
+    profiled = {fn[2] for fn in stats.stats}
+    assert "_device_tick" in profiled, sorted(profiled)[:20]
     cli.close_conn()
