@@ -616,7 +616,11 @@ def main() -> None:
         lines = [ln for ln in proc.stdout.decode().splitlines()
                  if ln.strip().startswith("{")]
         if proc.returncode == 0 and lines:
-            cpu_ref = json.loads(lines[-1])
+            rec = json.loads(lines[-1])
+            # a failed CPU run (error record, rc still 0 by design)
+            # must not masquerade as proof the harness works
+            if not rec.get("error"):
+                cpu_ref = rec
     except Exception as e:  # noqa: BLE001 — best-effort reference only
         _progress(f"cpu reference failed too: {e!r}")
     _failure("ladder", last_fail,

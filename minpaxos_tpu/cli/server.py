@@ -29,6 +29,11 @@ def main(argv=None) -> None:
                    help="use classic per-instance Multi-Paxos (explicit "
                         "Commit/CommitShort, per-instance ballots — "
                         "models/paxos.py; overrides -min)")
+    p.add_argument("-m", dest="mencius", action="store_true",
+                   help="use Mencius rotating-ownership consensus "
+                        "(models/mencius.py; the reference's -m flag, "
+                        "commented out in its server.go:58-79, runs "
+                        "here; overrides -min/-classic)")
     p.add_argument("-exec", dest="exec_", action="store_true", default=True,
                    help="execute committed commands (accepted for "
                         "reference flag compatibility; always on — "
@@ -76,17 +81,20 @@ def main(argv=None) -> None:
     print(f"server: registered as replica {my_id} of {len(nodes)}",
           flush=True)
 
+    protocol = ("mencius" if args.mencius
+                else "classic" if args.classic else "minpaxos")
     cfg = MinPaxosConfig(
         n_replicas=len(nodes), window=args.window, inbox=args.inbox,
         exec_batch=args.inbox, kv_pow2=16,
         catchup_rows=256, recovery_rows=256,
-        explicit_commit=args.classic)
+        explicit_commit=args.classic and not args.mencius)
     prof = cProfile.Profile() if args.cpuprofile else None
     flags = RuntimeFlags(dreply=args.dreply,
                          durable=args.durable, thrifty=args.thrifty,
                          beacon=args.beacon, store_dir=args.storedir,
                          profile=prof)
-    server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags)
+    server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags,
+                           protocol=protocol)
 
     server.start()
     print(f"server: replica {my_id} serving on {args.addr}:{args.port}",

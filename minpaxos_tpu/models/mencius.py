@@ -235,8 +235,16 @@ def mencius_step_impl(
         val_lo=state.val_lo.at[tgt_a].set(inbox.val_lo, mode="drop"),
         cmd_id=state.cmd_id.at[tgt_a].set(inbox.cmd_id, mode="drop"),
         client_id=state.client_id.at[tgt_a].set(inbox.client_id, mode="drop"),
+        # crt_inst ("max slot seen + 1, any owner") advances from ANY
+        # owner-plausible ACCEPT — including beyond-window ones a
+        # revived laggard can't apply. Without this its in_flight stays
+        # False and the takeover sweep below never fires, wedging its
+        # own frontier (and its clients) forever while the live cluster
+        # runs ahead.
         crt_inst=jnp.maximum(
-            state.crt_inst, jnp.max(jnp.where(acc_ok, inbox.inst, -1)) + 1),
+            state.crt_inst,
+            jnp.max(jnp.where(is_accept & (owner_ok | (inbox.ballot > 0)),
+                              inbox.inst, -1)) + 1),
         max_recv_ballot=jnp.maximum(
             state.max_recv_ballot,
             jnp.max(jnp.where(is_accept, inbox.ballot, 0))),
@@ -358,8 +366,15 @@ def mencius_step_impl(
         val_lo=state.val_lo.at[tgt_c].set(inbox.val_lo, mode="drop"),
         cmd_id=state.cmd_id.at[tgt_c].set(inbox.cmd_id, mode="drop"),
         client_id=state.client_id.at[tgt_c].set(inbox.client_id, mode="drop"),
+        # any COMMIT row advances crt_inst by both its inst and its
+        # piggybacked sender frontier (last_committed): a healing
+        # laggard otherwise thinks the log ends at each served chunk,
+        # in_flight drops, and its takeover sweep stops one chunk in
         crt_inst=jnp.maximum(
-            state.crt_inst, jnp.max(jnp.where(com_ok, inbox.inst, -1)) + 1),
+            state.crt_inst,
+            jnp.max(jnp.where(
+                is_commit,
+                jnp.maximum(inbox.inst, inbox.last_committed), -1)) + 1),
     )
 
     # ---- 7. takeover phase 1 (forceCommit :244-257, :878-897) ----
@@ -529,8 +544,15 @@ def mencius_step_impl(
     blocking = state.committed_upto + 1
     blk_owner = jnp.mod(blocking, R)
     i_am_successor = jnp.mod(blk_owner + 1, R) == me
-    do_tk = (i_am_successor & in_flight
-             & (state.stall_ticks >= cfg.noop_delay))
+    # successor-priority avoids ballot duels, but a revived laggard's
+    # frontier view is private — the blocking owner's successor (a live
+    # replica, far ahead) will never sweep FOR it. After a long stall
+    # any stuck replica sweeps its own blocked range; concurrent
+    # sweepers are ordered by their takeover ballots like any
+    # per-instance phase-1 competition.
+    do_tk = (in_flight
+             & ((i_am_successor & (state.stall_ticks >= cfg.noop_delay))
+                | (state.stall_ticks >= 4 * cfg.noop_delay)))
     # fresh takeover ballot when starting a new takeover episode
     new_tb = make_ballot(state.max_recv_ballot // 16 + 1, me)
     tb = jnp.where(do_tk & (state.takeover_ballot < 0), new_tb,

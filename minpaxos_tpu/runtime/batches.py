@@ -129,6 +129,13 @@ def frame_to_rows(buf: ColumnBuffer, kind: MsgKind, rows: np.ndarray,
         # frontier broadcast: inst carries committed_upto (count==0)
         buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
                    ballot=rows["ballot"], last_committed=rows["inst"])
+    elif kind == MsgKind.SKIP:
+        # Mencius cede range (menciusproto.go:7-11); device convention
+        # (models/mencius.py step 3): inst = cede end, last_committed =
+        # cede start
+        buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
+                   inst=rows["end_inst"],
+                   last_committed=rows["start_inst"])
     # READ / BEACON / handshake kinds are handled on the host path
     # (transport/replica), never as device rows.
 
@@ -200,6 +207,10 @@ def rows_to_frames(cols: dict, mask: np.ndarray) -> list[tuple[MsgKind, np.ndarr
             frame = make_batch(kind, leader_id=sub["src"][m],
                                inst=sub["last_committed"][m], count=0,
                                ballot=sub["ballot"][m])
+        elif kind == MsgKind.SKIP:
+            frame = make_batch(kind, leader_id=sub["src"][m],
+                               start_inst=sub["last_committed"][m],
+                               end_inst=sub["inst"][m])
         else:
             continue  # PROPOSE_REPLY etc. are built by the reply path
         out.append((kind, frame))

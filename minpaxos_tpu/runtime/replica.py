@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from minpaxos_tpu.models.minpaxos import (
@@ -84,7 +85,8 @@ class RuntimeFlags:
 class ReplicaServer:
     def __init__(self, me: int, addrs: list[tuple[str, int]],
                  cfg: MinPaxosConfig | None = None,
-                 flags: RuntimeFlags | None = None):
+                 flags: RuntimeFlags | None = None,
+                 protocol: str = "minpaxos"):
         self.me = me
         self.addrs = addrs
         self.cfg = cfg or MinPaxosConfig(
@@ -93,17 +95,33 @@ class ReplicaServer:
             recovery_rows=256)
         assert self.cfg.n_replicas == len(addrs)
         self.flags = flags or RuntimeFlags()
+        # protocol selection (reference server.go:58-79 — where every
+        # protocol but -min is commented out, mencius here actually
+        # runs): "minpaxos" / "classic" share replica_step (classic via
+        # cfg.explicit_commit); "mencius" swaps in the rotating-
+        # ownership kernel. Leaderless paths (elections, leader-serving
+        # catch-up, ballot-promise restore) are gated on self.protocol.
+        self.protocol = protocol
+        if protocol == "mencius":
+            from minpaxos_tpu.models.mencius import (
+                init_mencius,
+                mencius_step,
+            )
+
+            step_fn, init_fn = mencius_step, init_mencius
+        else:
+            step_fn, init_fn = replica_step, init_replica
         self.transport = Transport(me, addrs)
         self.queue = self.transport.queue
         # the MODULE-level jitted step (static cfg): every replica in
         # the process shares ONE compile cache — N private jax.jit
         # wrappers would compile the same kernel N times concurrently,
         # which starves small hosts (in-process test clusters)
-        self.step = functools.partial(replica_step, self.cfg)
+        self.step = functools.partial(step_fn, self.cfg)
         # copy every leaf: jax caches/aliases equal small constants, and
         # donation rejects the same buffer appearing twice
         self.state = jax.tree_util.tree_map(
-            lambda x: x.copy(), init_replica(self.cfg, me))
+            lambda x: x.copy(), init_fn(self.cfg, me))
         self.store = StableStore(
             f"{self.flags.store_dir}/stable-store-replica{me}",
             sync=self.flags.durable)
@@ -122,8 +140,12 @@ class ReplicaServer:
         # control-plane snapshot: the protocol thread swaps in a fresh
         # plain-Python dict each tick; other threads only ever read it.
         # They must NOT touch self.state — its arrays are donated into
-        # the jitted step and die mid-tick.
-        self.snapshot = {"frontier": -1, "leader": -1, "prepared": False}
+        # the jitted step and die mid-tick. Keys here must match what
+        # _device_tick publishes: readers (_mencius_store_answer, the
+        # control plane) can run off a frame drained BEFORE the first
+        # tick ever replaces this dict.
+        self.snapshot = {"frontier": -1, "leader": -1, "prepared": False,
+                         "window_base": 0}
 
     # ---------------- lifecycle ----------------
 
@@ -171,15 +193,35 @@ class ReplicaServer:
         frontier = self.store.committed_prefix()
         max_ballot = self.store.max_ballot()
         chunk = self.cfg.exec_batch
+        own_max = -1  # highest recorded slot owned by me (mencius)
+
+        def _own_slots_max(rec) -> int:
+            mine = rec["inst"][rec["inst"] % self.cfg.n_replicas == self.me]
+            return int(mine.max()) if len(mine) else -1
+
         for lo in range(0, frontier + 1, chunk):
             rec = self.store.read_range(lo, min(lo + chunk, frontier + 1) - 1)
+            own_max = max(own_max, _own_slots_max(rec))
             self._feed_records(rec, MsgKind.COMMIT)
         tail = self.store.read_range(frontier + 1, self.store.max_inst())
         if len(tail):
+            own_max = max(own_max, _own_slots_max(tail))
             self._feed_records(tail, MsgKind.ACCEPT)
-        # restore the ballot promise (ballot low 4 bits = proposer id,
-        # bareminpaxos.go:383-385)
-        if max_ballot > 0:
+        if self.protocol == "mencius":
+            # no global ballot promise to restore. But crt_own MUST
+            # move past every recorded own slot: the propose path
+            # writes at crt_own unguarded (fresh slots by invariant),
+            # so a stale cursor would overwrite recovered state. The
+            # maximum is accumulated during the chunked replay above —
+            # one whole-mirror read here would defeat that chunking.
+            if own_max >= 0:
+                self.state = self.state._replace(
+                    crt_own=jnp.maximum(
+                        self.state.crt_own,
+                        jnp.int32(own_max + self.cfg.n_replicas)))
+        elif max_ballot > 0:
+            # restore the ballot promise (ballot low 4 bits = proposer
+            # id, bareminpaxos.go:383-385)
             buf = batches.ColumnBuffer(self.cfg.inbox)
             buf.append(1, kind=int(MsgKind.PREPARE), src=max_ballot % 16,
                        ballot=max_ballot,
@@ -193,11 +235,16 @@ class ReplicaServer:
             return
         k_hi, k_lo = split_i64(rec["key"])
         v_hi, v_lo = split_i64(rec["val"])
+        # row src: MinPaxos ballots encode the proposer in their low 4
+        # bits; Mencius ownership is positional (owner = inst mod R,
+        # mencius.go:431-432) and its accept guard checks exactly that
+        src_all = (rec["inst"] % self.cfg.n_replicas
+                   if self.protocol == "mencius" else rec["ballot"] % 16)
         for lo in range(0, len(rec), self.cfg.inbox):
             sl = slice(lo, lo + self.cfg.inbox)
             buf = batches.ColumnBuffer(self.cfg.inbox)
             buf.append(len(rec[sl]), kind=int(kind),
-                       src=rec["ballot"][sl] % 16, ballot=rec["ballot"][sl],
+                       src=src_all[sl], ballot=rec["ballot"][sl],
                        inst=rec["inst"][sl],
                        last_committed=self.store.frontier,
                        op=rec["op"][sl].astype(np.int32),
@@ -286,10 +333,12 @@ class ReplicaServer:
         if prof is not None:
             prof.enable()
         try:
-            if not self._recovered and self.me == 0:
+            if (not self._recovered and self.me == 0
+                    and self.protocol != "mencius"):
                 # initial boot: replica 0 self-elects
                 # (bareminpaxos.go:286-290); wait until the mesh is up
-                # so the PREPARE reaches everyone
+                # so the PREPARE reaches everyone. Mencius has no
+                # leader — every replica proposes into its own slots.
                 self._wait_for_peers()
                 self.queue.put((CONTROL, 0, "be_the_leader", None))
             while not self._stop.is_set():
@@ -382,6 +431,15 @@ class ReplicaServer:
                     for c in rows["cmd_id"]:
                         self._pending[(conn_id, int(c))] = MsgKind.PROPOSE_REPLY
                     self.stats["proposals"] += len(rows)
+                if (self.protocol == "mencius"
+                        and kind == MsgKind.PREPARE_INST):
+                    # beyond-retention heal: a revived laggard's
+                    # takeover sweep asks about slots we already slid
+                    # out; the device can't answer (out of window) but
+                    # the stable store's mirror can — serve the range
+                    # as COMMIT rows (the mencius counterpart of
+                    # MinPaxos's leader-side _host_catchup)
+                    self._mencius_store_answer(rows)
                 batches.frame_to_rows(self.inbox, kind, rows, conn_id)
             if self.inbox.room() <= 0:
                 break
@@ -391,7 +449,37 @@ class ReplicaServer:
                 break
         return elect
 
+    def _mencius_store_answer(self, rows) -> None:
+        """Serve a takeover sweep that reaches below our window from
+        the durable mirror: COMMIT rows for [lowest asked slot,
+        committed prefix], chunked by catchup_rows. Not capped at the
+        asked range — the laggard's crt_inst advances from the commits
+        it applies, which is what lets its next sweep reach further
+        (its own view of the log tip is stale by exactly the gap)."""
+        base = self.snapshot["window_base"]
+        lo = int(rows["inst"].min())
+        if lo >= base:
+            return  # in-window: the device answers
+        hi = min(lo + self.cfg.catchup_rows - 1, self.store.committed_prefix())
+        if hi < lo:
+            return
+        rec = self.store.read_range(lo, hi)
+        if len(rec) == 0:
+            return
+        frame = make_batch(
+            MsgKind.COMMIT, leader_id=self.me, inst=rec["inst"],
+            ballot=rec["ballot"], op=rec["op"], key=rec["key"],
+            val=rec["val"], cmd_id=rec["cmd_id"],
+            client_id=rec["client_id"],
+            last_committed=self.snapshot["frontier"])
+        q = int(rows["leader_id"][0])
+        if 0 <= q < self.cfg.n_replicas and q != self.me:
+            self._send_or_redial(q, MsgKind.COMMIT, frame)
+            self.transport.flush_all()
+
     def _become_leader(self) -> None:
+        if self.protocol == "mencius":
+            return  # no leaders; master be_the_leader promotions no-op
         self.state, prep = become_leader(self.cfg, self.state)
         cols = {c: np.asarray(getattr(prep, c)) for c in batches.COLS
                 if c != "kind"}
@@ -423,12 +511,22 @@ class ReplicaServer:
             self.transport.flush_all()
         self._idle = (n_rows == 0 and not (out_cols["kind"] != 0).any()
                       and int(np.asarray(execr.count)) == 0)
-        self.snapshot = {
-            "frontier": int(np.asarray(self.state.committed_upto)),
-            "leader": int(np.asarray(self.state.leader_id)),
-            "prepared": bool(np.asarray(self.state.prepared)),
-            "window_base": int(np.asarray(self.state.window_base)),
-        }
+        if self.protocol == "mencius":
+            # leaderless: leader=-1 hints clients any replica serves;
+            # prepared=True keeps the re-prepare wedge-guard inert
+            self.snapshot = {
+                "frontier": int(np.asarray(self.state.committed_upto)),
+                "leader": -1,
+                "prepared": True,
+                "window_base": int(np.asarray(self.state.window_base)),
+            }
+        else:
+            self.snapshot = {
+                "frontier": int(np.asarray(self.state.committed_upto)),
+                "leader": int(np.asarray(self.state.leader_id)),
+                "prepared": bool(np.asarray(self.state.prepared)),
+                "window_base": int(np.asarray(self.state.window_base)),
+            }
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
 
@@ -502,6 +600,31 @@ class ReplicaServer:
                          join_i64(out_cols["key_hi"][t][m], out_cols["key_lo"][t][m]),
                          join_i64(out_cols["val_hi"][t][m], out_cols["val_lo"][t][m]),
                          out_cols["cmd_id"][t][m], out_cols["client_id"][t][m]))
+        if self.protocol == "mencius":
+            # SKIP ranges commit no-ops for the ceder's owned slots
+            # (models/mencius.py steps 3-4); without records for them
+            # the committed prefix would have permanent holes on replay
+            from minpaxos_tpu.wire.messages import Op as _Op
+
+            for cols_, hi in ((in_cols, n), (out_cols, None)):
+                ks = cols_["kind"][:hi]
+                for j in np.nonzero(ks == int(MsgKind.SKIP))[0]:
+                    owner = int(cols_["src"][:hi][j])
+                    start = int(cols_["last_committed"][:hi][j])
+                    end = int(cols_["inst"][:hi][j])
+                    if end < start:
+                        continue
+                    slots = np.arange(start, end + 1, dtype=np.int64)
+                    slots = slots[slots % self.cfg.n_replicas == owner]
+                    slots = slots[~self.store.is_committed(slots)]
+                    if len(slots):
+                        z = np.zeros(len(slots), np.int64)
+                        recs.append((slots.astype(np.int32),
+                                     z.astype(np.int32),
+                                     np.full(len(slots), COMMITTED),
+                                     np.full(len(slots), int(_Op.NONE)),
+                                     z, z, z.astype(np.int32),
+                                     np.full(len(slots), -1, np.int32)))
         wrote = False
         for inst, ballot, status, op, key, val, cmd, cli in recs:
             if len(inst):
@@ -614,6 +737,12 @@ class ReplicaServer:
         catch-up rows (they slid out); serve it from the stable store's
         in-memory mirror instead — the runtime's replacement for the
         reference replaying its whole file to the new process."""
+        if self.protocol == "mencius":
+            # leaderless: there is no leader to push catch-up. Healing
+            # is PULL-based instead — the laggard's takeover sweep
+            # (kernel) plus peers' store-served COMMIT answers to
+            # beyond-window PREPARE_INSTs (_mencius_store_answer).
+            return
         if not bool(np.asarray(self.state.prepared)):
             return
         if int(np.asarray(self.state.leader_id)) != self.me:

@@ -27,7 +27,9 @@ class Harness:
     """Boot master + N replicas on fresh localhost ports."""
 
     def __init__(self, tmp_path, n=3, durable=False, thrifty=False,
-                 classic=False, flags_overrides=None):
+                 classic=False, mencius=False, flags_overrides=None):
+        self.protocol = ("mencius" if mencius
+                         else "classic" if classic else "minpaxos")
         # replica data ports need their +1000 control sibling free too
         self.mport = free_ports(1)[0]
         self.addrs = [("127.0.0.1", p) for p in
@@ -58,7 +60,8 @@ class Harness:
             time.sleep(0.05)
 
     def start_replica(self, i) -> None:
-        s = ReplicaServer(i, self.addrs, self.cfg, self.flags(i))
+        s = ReplicaServer(i, self.addrs, self.cfg, self.flags(i),
+                          protocol=self.protocol)
         s.start()
         self.servers[i] = s
 
@@ -328,4 +331,45 @@ def test_cpuprofile_captures_protocol_thread(harness):
     stats = pstats.Stats(prof)
     profiled = {fn[2] for fn in stats.stats}
     assert "_device_tick" in profiled, sorted(profiled)[:20]
+    cli.close_conn()
+
+
+def test_mencius_over_tcp(harness):
+    """Mencius as a real TCP server protocol (server -m): the
+    reference compiled mencius but commented it out of server.go:58-79
+    — here it runs. One client proposes to replica 0; the idle owners
+    cede their interleaved slots via wire SKIP frames and every
+    command commits exactly-once."""
+    h = harness(mencius=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(400, seed=13)
+    stats = cli.run_workload(ops, keys, vals, timeout_s=60)
+    assert stats["acked"] == 400, stats
+    assert stats["duplicates"] == 0
+    cli.close_conn()
+
+
+def test_mencius_tcp_dead_owner_takeover_and_revive(harness, tmp_path):
+    """Kill an idle owner: its slots stop ceding and the frontier
+    blocks until the takeover sweep (forceCommit, mencius.go:878-897)
+    no-op-fills them over TCP. Revive it from the durable store and
+    check it heals back to the cluster frontier (replay + takeover)."""
+    h = harness(mencius=True, durable=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(200, seed=14)
+    assert cli.run_workload(ops, keys, vals, timeout_s=60)["acked"] == 200
+    h.kill(2)
+    ops2, keys2, vals2 = gen_workload(200, seed=15)
+    cli.replies.clear()
+    stats = cli.run_workload(ops2, keys2, vals2, timeout_s=60)
+    assert stats["acked"] == 200, stats  # commits despite the dead owner
+    h.start_replica(2)
+    deadline = time.monotonic() + 30
+    target = h.servers[0].snapshot["frontier"]
+    while time.monotonic() < deadline:
+        if h.servers[2].snapshot["frontier"] >= target:
+            break
+        time.sleep(0.1)
+    assert h.servers[2].snapshot["frontier"] >= target, (
+        h.servers[2].snapshot, target)
     cli.close_conn()

@@ -140,4 +140,3 @@ def test_stable_store_torn_tail(tmp_path):
     assert r.committed_prefix() == 2
     assert len(r.read_range(0, 10)) == 3
     r.close()
-
