@@ -1,18 +1,20 @@
-"""BASELINE config 1: the reference's own deployment shape, measured.
+"""TCP-runtime benchmarks: the reference's own deployment shape, measured.
 
-Boots master + 3 MinPaxos replica servers (``-min -durable``) as REAL
-processes on localhost — the bareminrun.sh topology (reference
-bareminrun.sh:16-21) — then runs the closed-loop client with ``-check``
-(simpletest.sh:1) plus a per-op latency pass, and writes one JSON
-record to BENCH_TCP.json:
+Boots master + 3 replica servers as REAL processes on localhost — the
+bareminrun.sh topology (reference bareminrun.sh:16-21) — then runs the
+closed-loop client with ``-check`` (simpletest.sh:1) plus a per-op
+serial-latency pass. Two configs:
 
-    {"config": "bareminpaxos_tcp_3rep", "ops_per_sec": ...,
-     "p50_ms": ..., "p99_ms": ..., "check": "ok", ...}
+* ``-min -durable``  — BASELINE config 1 (bareminpaxos, the shape the
+  reference's scripts measure); this is the record's top level.
+* ``-m -durable``    — the same deployment running Mencius (the
+  reference compiled it but never wired it into its server binary);
+  recorded under ``"mencius_tcp"``.
 
-Run directly (``python bench_tcp.py``) or let bench.py's caller pick
-the file up next to BENCH_r{N}.json. Servers run on the CPU JAX
-backend (N processes cannot share one TPU — models/cluster.py pod mode
-is the on-accelerator deployment; this config measures the HOST
+Writes one JSON object to BENCH_TCP.json. Run: ``python bench_tcp.py``
+(``BENCH_TCP_Q`` overrides the request count). Servers run on the CPU
+JAX backend (N processes cannot share one TPU — models/cluster.py pod
+mode is the on-accelerator deployment; this file measures the HOST
 runtime: framed TCP wire, batched column packing, durable store).
 """
 
@@ -35,17 +37,10 @@ def _progress(msg: str) -> None:
     print(f"[bench_tcp] {msg}", file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    q = int(os.environ.get("BENCH_TCP_Q", "2000"))
-    out_path = REPO / "BENCH_TCP.json"
-    # opportunistic native build: every server/client process then
-    # loads the C++ frame scan off disk (pure-Python fallback if no g++)
-    try:
-        from minpaxos_tpu.native.build import build as _native_build
-
-        _native_build(quiet=True)
-    except Exception:
-        pass
+def run_config(proto_flag: str, label: str, ref_shape: str,
+               q: int) -> dict:
+    """Boot a fresh 3-replica cluster with ``proto_flag``, measure
+    closed-loop throughput (-check) + 200 serial ops, tear down."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
     # control ports are data+1000 (reference scheme); pick data ports
     # whose +1000 sibling is verified free too
@@ -69,13 +64,14 @@ def main() -> None:
             # — measured 56ms -> 24ms p50 on the CPU backend. 4096
             # comfortably covers the client's <=1024 outstanding ops.
             procs.append(subprocess.Popen(
-                [sys.executable, "-m", "minpaxos_tpu.cli.server", "-min",
-                 "-durable", "-port", str(p), "-mport", str(mport),
+                [sys.executable, "-m", "minpaxos_tpu.cli.server",
+                 proto_flag, "-durable", "-port", str(p),
+                 "-mport", str(mport),
                  "-window", "4096", "-inbox", "2048",
                  "-storedir", str(tmp)],
                 env=env, cwd=tmp, stdout=subprocess.DEVNULL,
                 stderr=subprocess.DEVNULL))
-        _progress("cluster booting")
+        _progress(f"{label}: cluster booting")
 
         from minpaxos_tpu.runtime.client import Client, gen_workload
 
@@ -89,22 +85,28 @@ def main() -> None:
                 time.sleep(1.0)
         if cli is None:
             raise RuntimeError("cluster never came up")
-        _progress("client connected")
+        _progress(f"{label}: client connected")
 
         # warmup (includes the servers' first jit compiles); retried —
         # the replicas' data listeners come up only after their first
         # jax import/compile, well after the master answers
         ops, keys, vals = gen_workload(100, seed=1)
-        deadline = time.monotonic() + 180
+        deadline = time.monotonic() + 300
         while True:
             try:
                 if cli.run_workload(ops, keys, vals,
-                                    timeout_s=120)["acked"] == 100:
+                                    timeout_s=60)["acked"] == 100:
                     break
+                # run_workload returns partial stats on timeout rather
+                # than raising — the deadline must bound THIS path too
+                # or a cluster that never heals loops forever
+                if time.monotonic() > deadline:
+                    raise RuntimeError("warmup never acked 100/100")
+                _progress(f"{label}: warmup incomplete, retrying")
             except (ConnectionError, OSError, TimeoutError) as e:
                 if time.monotonic() > deadline:
                     raise RuntimeError(f"warmup never succeeded: {e!r}")
-                _progress(f"warmup retry ({e!r})")
+                _progress(f"{label}: warmup retry ({e!r})")
                 time.sleep(2.0)
                 try:
                     cli.close_conn()
@@ -135,7 +137,7 @@ def main() -> None:
                 lats.append((time.perf_counter() - t1) * 1e3)
         lats.sort()
         rec = {
-            "config": "bareminpaxos_tcp_3rep_durable (BASELINE config 1)",
+            "config": label,
             "ops_per_sec": round(q / wall, 1),
             "acked": stats["acked"],
             "check": "ok" if ok else f"FAILED {stats}",
@@ -143,11 +145,10 @@ def main() -> None:
             "serial_p99_ms": round(lats[int(len(lats) * 0.99)], 3)
             if lats else None,
             "n_serial": len(lats),
-            "reference_shape": "bareminrun.sh:16-21 + simpletest.sh:1",
+            "reference_shape": ref_shape,
         }
-        out_path.write_text(json.dumps(rec) + "\n")
-        print(json.dumps(rec))
         cli.close_conn()
+        return rec
     finally:
         for p in procs:
             try:
@@ -162,6 +163,35 @@ def main() -> None:
                 pass
         for f in tmp.glob("stable-store-replica*"):
             f.unlink()
+
+
+def main() -> None:
+    q = int(os.environ.get("BENCH_TCP_Q", "2000"))
+    out_path = REPO / "BENCH_TCP.json"
+    # opportunistic native build: every server/client process then
+    # loads the C++ frame scan off disk (pure-Python fallback if no g++)
+    try:
+        from minpaxos_tpu.native.build import build as _native_build
+
+        _native_build(quiet=True)
+    except Exception:
+        pass
+
+    rec = run_config(
+        "-min", "bareminpaxos_tcp_3rep_durable (BASELINE config 1)",
+        "bareminrun.sh:16-21 + simpletest.sh:1", q)
+    # persist the headline immediately: an abort during the minutes-long
+    # mencius leg (Ctrl-C, SIGTERM) must not discard a finished run
+    out_path.write_text(json.dumps(rec) + "\n")
+    try:
+        rec["mencius_tcp"] = run_config(
+            "-m", "mencius_tcp_3rep_durable (beyond reference: its "
+            "server never shipped mencius)",
+            "mencius.go:83-897 over the bareminrun.sh topology", q)
+    except Exception as e:  # noqa: BLE001 — config 1 is the headline
+        rec["mencius_tcp"] = {"error": repr(e)[:200]}
+    out_path.write_text(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
