@@ -373,3 +373,27 @@ def test_mencius_tcp_dead_owner_takeover_and_revive(harness, tmp_path):
     assert h.servers[2].snapshot["frontier"] >= target, (
         h.servers[2].snapshot, target)
     cli.close_conn()
+
+
+def test_classic_paxos_leader_kill_election(harness):
+    """Classic per-instance Paxos shares the election machinery but
+    commits only via explicit Commit/CommitShort — a new leader must
+    finish the old leader's in-flight instances through the
+    per-instance phase-1 sweep (paxos.go:388-442) before serving."""
+    h = harness(classic=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(200, seed=21)
+    assert cli.run_workload(ops, keys, vals, timeout_s=30)["acked"] == 200
+    h.kill(0)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if h.master.leader != 0:
+            break
+        time.sleep(0.1)
+    assert h.master.leader != 0
+    cli.replies.clear()
+    ops2, keys2, vals2 = gen_workload(200, seed=22)
+    stats = cli.run_workload(ops2, keys2, vals2, timeout_s=40)
+    assert stats["acked"] == 200, stats
+    assert stats["duplicates"] == 0
+    cli.close_conn()
