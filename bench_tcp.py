@@ -170,12 +170,9 @@ def main() -> None:
     out_path = REPO / "BENCH_TCP.json"
     # opportunistic native build: every server/client process then
     # loads the C++ frame scan off disk (pure-Python fallback if no g++)
-    try:
-        from minpaxos_tpu.native.build import build as _native_build
+    from minpaxos_tpu.native.build import try_build
 
-        _native_build(quiet=True)
-    except Exception:
-        pass
+    try_build()
 
     rec = run_config(
         "-min", "bareminpaxos_tcp_3rep_durable (BASELINE config 1)",

@@ -60,12 +60,9 @@ def main(argv=None) -> None:
 
     # opportunistic native-layer build (C++ frame scan + cycle clock);
     # everything falls back to pure Python when g++ is absent
-    try:
-        from minpaxos_tpu.native.build import build as _native_build
+    from minpaxos_tpu.native.build import try_build
 
-        _native_build(quiet=True)
-    except Exception:
-        pass
+    try_build()
 
     import jax
 

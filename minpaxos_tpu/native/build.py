@@ -54,6 +54,16 @@ def build(force: bool = False, quiet: bool = False) -> str | None:
     return OUT
 
 
+def try_build() -> None:
+    """Best-effort build for entry points: never raises (no toolchain,
+    broken compiler, read-only checkout — the pure-Python fallbacks
+    cover all of it)."""
+    try:
+        build(quiet=True)
+    except Exception:  # noqa: BLE001 — opportunistic by design
+        pass
+
+
 if __name__ == "__main__":
     path = build(force="--force" in sys.argv[1:])
     if path is None:

@@ -449,6 +449,19 @@ class ReplicaServer:
                 break
         return elect
 
+    def _store_commit_frame(self, lo: int, hi: int, frontier: int):
+        """A COMMIT wire frame of store-mirror records for [lo, hi],
+        or None if no records exist — the building block of both
+        store-served heal paths (_host_catchup, _mencius_store_answer)."""
+        rec = self.store.read_range(lo, hi)
+        if len(rec) == 0:
+            return None
+        return make_batch(
+            MsgKind.COMMIT, leader_id=self.me, inst=rec["inst"],
+            ballot=rec["ballot"], op=rec["op"], key=rec["key"],
+            val=rec["val"], cmd_id=rec["cmd_id"],
+            client_id=rec["client_id"], last_committed=frontier)
+
     def _mencius_store_answer(self, rows) -> None:
         """Serve a takeover sweep that reaches below our window from
         the durable mirror: COMMIT rows for [lowest asked slot,
@@ -463,17 +476,9 @@ class ReplicaServer:
         hi = min(lo + self.cfg.catchup_rows - 1, self.store.committed_prefix())
         if hi < lo:
             return
-        rec = self.store.read_range(lo, hi)
-        if len(rec) == 0:
-            return
-        frame = make_batch(
-            MsgKind.COMMIT, leader_id=self.me, inst=rec["inst"],
-            ballot=rec["ballot"], op=rec["op"], key=rec["key"],
-            val=rec["val"], cmd_id=rec["cmd_id"],
-            client_id=rec["client_id"],
-            last_committed=self.snapshot["frontier"])
+        frame = self._store_commit_frame(lo, hi, self.snapshot["frontier"])
         q = int(rows["leader_id"][0])
-        if 0 <= q < self.cfg.n_replicas and q != self.me:
+        if frame is not None and 0 <= q < self.cfg.n_replicas and q != self.me:
             self._send_or_redial(q, MsgKind.COMMIT, frame)
             self.transport.flush_all()
 
@@ -511,22 +516,17 @@ class ReplicaServer:
             self.transport.flush_all()
         self._idle = (n_rows == 0 and not (out_cols["kind"] != 0).any()
                       and int(np.asarray(execr.count)) == 0)
-        if self.protocol == "mencius":
-            # leaderless: leader=-1 hints clients any replica serves;
-            # prepared=True keeps the re-prepare wedge-guard inert
-            self.snapshot = {
-                "frontier": int(np.asarray(self.state.committed_upto)),
-                "leader": -1,
-                "prepared": True,
-                "window_base": int(np.asarray(self.state.window_base)),
-            }
-        else:
-            self.snapshot = {
-                "frontier": int(np.asarray(self.state.committed_upto)),
-                "leader": int(np.asarray(self.state.leader_id)),
-                "prepared": bool(np.asarray(self.state.prepared)),
-                "window_base": int(np.asarray(self.state.window_base)),
-            }
+        mencius = self.protocol == "mencius"
+        self.snapshot = {
+            "frontier": int(np.asarray(self.state.committed_upto)),
+            "window_base": int(np.asarray(self.state.window_base)),
+            # mencius is leaderless: leader=-1 hints clients any
+            # replica serves; prepared=True keeps the re-prepare
+            # wedge-guard inert
+            "leader": -1 if mencius else int(np.asarray(self.state.leader_id)),
+            "prepared": (True if mencius
+                         else bool(np.asarray(self.state.prepared))),
+        }
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
 
@@ -748,18 +748,12 @@ class ReplicaServer:
         if int(np.asarray(self.state.leader_id)) != self.me:
             return
         base = int(np.asarray(self.state.window_base))
+        fr = int(np.asarray(self.state.committed_upto))
         pc = np.asarray(self.state.peer_commits)
         for q in range(self.cfg.n_replicas):
             if q == self.me or pc[q] + 1 >= base:
                 continue
-            rec = self.store.read_range(int(pc[q]) + 1,
-                                        min(int(pc[q]) + 256, base - 1))
-            if len(rec) == 0:
-                continue
-            frame = make_batch(
-                MsgKind.COMMIT, leader_id=self.me, inst=rec["inst"],
-                ballot=rec["ballot"], op=rec["op"], key=rec["key"],
-                val=rec["val"], cmd_id=rec["cmd_id"],
-                client_id=rec["client_id"],
-                last_committed=int(np.asarray(self.state.committed_upto)))
-            self._send_or_redial(q, MsgKind.COMMIT, frame)
+            frame = self._store_commit_frame(
+                int(pc[q]) + 1, min(int(pc[q]) + 256, base - 1), fr)
+            if frame is not None:
+                self._send_or_redial(q, MsgKind.COMMIT, frame)
