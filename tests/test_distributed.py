@@ -397,3 +397,27 @@ def test_classic_paxos_leader_kill_election(harness):
     assert stats["acked"] == 200, stats
     assert stats["duplicates"] == 0
     cli.close_conn()
+
+
+def test_mencius_proposer_kill_failover(harness):
+    """Kill the replica clients propose to (mencius has no leader, but
+    the master still hints one): the master promotes another replica,
+    the client fails over, and the dead owner's slots are taken over —
+    commits continue exactly-once."""
+    h = harness(mencius=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(150, seed=31)
+    assert cli.run_workload(ops, keys, vals, timeout_s=60)["acked"] == 150
+    h.kill(0)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if h.master.leader != 0:
+            break
+        time.sleep(0.1)
+    assert h.master.leader != 0
+    cli.replies.clear()
+    ops2, keys2, vals2 = gen_workload(150, seed=32)
+    stats = cli.run_workload(ops2, keys2, vals2, timeout_s=60)
+    assert stats["acked"] == 150, stats
+    assert stats["duplicates"] == 0
+    cli.close_conn()
