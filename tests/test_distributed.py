@@ -421,3 +421,28 @@ def test_mencius_proposer_kill_failover(harness):
     assert stats["acked"] == 150, stats
     assert stats["duplicates"] == 0
     cli.close_conn()
+
+
+def test_majority_loss_stalls_then_resumes(harness, tmp_path):
+    """Kill BOTH followers (majority lost): nothing may commit — then
+    revive one and the cluster must resume and finish the workload
+    exactly-once. The stall phase is the safety half of the spec: a
+    minority leader accepting writes silently would be the bug."""
+    h = harness(durable=True)
+    cli = h.client()
+    ops, keys, vals = gen_workload(100, seed=41)
+    assert cli.run_workload(ops, keys, vals, timeout_s=30)["acked"] == 100
+    before = h.servers[0].snapshot["frontier"]
+    h.kill(1)
+    h.kill(2)
+    cli.replies.clear()
+    ops2, keys2, vals2 = gen_workload(100, seed=42)
+    stats = cli.run_workload(ops2, keys2, vals2, timeout_s=6)
+    assert stats["acked"] == 0, stats  # no quorum -> no commits
+    assert h.servers[0].snapshot["frontier"] == before
+    h.start_replica(1)  # majority restored (its store is fresh: healed
+    # by the leader's catch-up rows)
+    stats = cli.run_workload(ops2, keys2, vals2, timeout_s=40)
+    assert stats["acked"] == 100, stats
+    assert stats["duplicates"] == 0
+    cli.close_conn()
