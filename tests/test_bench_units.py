@@ -87,3 +87,39 @@ def test_free_ports_collision_skipped():
         assert blocked_sibling - 1000 not in ports
     finally:
         held.close()
+
+
+def test_keybuf_amortized_append_and_view():
+    from minpaxos_tpu.models.cluster import KeyBuf, pack_reply_key
+
+    kb = KeyBuf()
+    expect = []
+    for i in range(40):  # crosses several doubling boundaries
+        keys = pack_reply_key(i % 5, np.arange(i * 31, i * 31 + 17))
+        kb.append(keys)
+        expect.append(np.atleast_1d(keys))
+    got = kb.view()
+    ref = np.concatenate(expect)
+    assert got.dtype == np.int64 and np.array_equal(got, ref)
+    # scalar append path
+    kb2 = KeyBuf()
+    kb2.append(pack_reply_key(7, 9))
+    assert kb2.view().tolist() == [(7 << 32) | 9]
+
+
+def test_pack_reply_key_no_collisions_across_clients():
+    from minpaxos_tpu.models.cluster import pack_reply_key
+
+    a = pack_reply_key(1, np.arange(1000))
+    b = pack_reply_key(2, np.arange(1000))
+    assert len(np.intersect1d(a, b)) == 0
+    # cmd_id is masked to 32 bits; same (cid, mid) always packs equal
+    assert pack_reply_key(3, 5) == pack_reply_key(3, 5)
+
+
+def test_free_ports_impossible_request_raises():
+    import pytest
+
+    with pytest.raises(OSError):
+        # no port p can have p+70000 as a sibling (> 65535)
+        free_ports(1, sibling_offset=70000)
