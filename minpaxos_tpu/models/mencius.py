@@ -43,7 +43,6 @@ driven through classic per-instance phase 1.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

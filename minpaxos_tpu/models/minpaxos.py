@@ -32,7 +32,6 @@ the reference's cold paths deliberately stay off the device
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

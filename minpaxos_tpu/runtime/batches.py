@@ -22,7 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from minpaxos_tpu.ops.packed import join_i64, split_i64
-from minpaxos_tpu.wire.messages import MsgKind, empty_batch, make_batch
+from minpaxos_tpu.wire.messages import MsgKind, make_batch
 
 COLS = ("kind", "src", "ballot", "inst", "last_committed", "op",
         "key_hi", "key_lo", "val_hi", "val_lo", "cmd_id", "client_id")

@@ -25,7 +25,7 @@ import queue
 import socket
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,7 @@ from minpaxos_tpu.models.minpaxos import (
 )
 from minpaxos_tpu.ops.packed import join_i64, split_i64
 from minpaxos_tpu.runtime import batches
-from minpaxos_tpu.runtime.stable import SLOT_DT, StableStore
+from minpaxos_tpu.runtime.stable import StableStore
 from minpaxos_tpu.runtime.transport import (
     CONN_LOST,
     FROM_CLIENT,
