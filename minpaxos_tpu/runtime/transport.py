@@ -69,7 +69,19 @@ class Transport:
         host, port = self.addrs[self.me]
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((host, port))
+        # retry: a quickly-revived replica (kill/revive harnesses, the
+        # reference's singleserverreconnect.sh shape) can race its
+        # predecessor's listener close — same retry the control port
+        # has always had (replica.py _start_control)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                s.bind((host, port))
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
         s.listen(64)
         self._listener = s
         threading.Thread(target=self._accept_loop, daemon=True).start()

@@ -446,3 +446,39 @@ def test_majority_loss_stalls_then_resumes(harness, tmp_path):
     assert stats["acked"] == 100, stats
     assert stats["duplicates"] == 0
     cli.close_conn()
+
+
+def test_chaos_follower_churn_exactly_once(harness):
+    """Randomized kill/revive churn of followers under continuous
+    load (the TCP-runtime cousin of tests/test_safety_random.py):
+    whatever the interleaving of socket deaths, redials, store replays
+    and catch-up, every command acks exactly once."""
+    rng = np.random.default_rng(5150)
+    h = harness(durable=True)
+    cli = h.client()
+    total = 0
+    for phase in range(4):
+        victim = int(rng.integers(1, 3))  # churn followers only
+        if victim in h.servers:
+            h.kill(victim)
+        n = int(rng.integers(80, 160))
+        ops, keys, vals = gen_workload(n, conflict_pct=30, seed=60 + phase)
+        cli.replies.clear()
+        stats = cli.run_workload(ops, keys, vals, timeout_s=40)
+        assert stats["acked"] == n, (phase, stats)
+        assert stats["duplicates"] == 0, (phase, stats)
+        total += n
+        if victim not in h.servers:
+            h.start_replica(victim)
+        time.sleep(0.2)
+    # final convergence: both followers alive again, frontiers meet
+    deadline = time.monotonic() + 30
+    target = h.servers[0].snapshot["frontier"]
+    while time.monotonic() < deadline:
+        if all(h.servers[i].snapshot["frontier"] >= target
+               for i in (1, 2) if i in h.servers):
+            break
+        time.sleep(0.1)
+    for i in (1, 2):
+        assert h.servers[i].snapshot["frontier"] >= target
+    cli.close_conn()
