@@ -482,3 +482,27 @@ def test_chaos_follower_churn_exactly_once(harness):
     for i in (1, 2):
         assert h.servers[i].snapshot["frontier"] >= target
     cli.close_conn()
+
+
+def test_mencius_chaos_owner_churn_exactly_once(harness):
+    """Owner kill/revive churn for the Mencius TCP path: each dead
+    owner forces takeover no-op fills; each revival forces pull-based
+    healing (store replay + takeover sweeps + store-served commits).
+    Exactly-once must hold throughout."""
+    rng = np.random.default_rng(6001)
+    h = harness(mencius=True, durable=True)
+    cli = h.client()
+    for phase in range(3):
+        victim = int(rng.integers(1, 3))  # keep the hinted proposer up
+        if victim in h.servers:
+            h.kill(victim)
+        n = int(rng.integers(60, 120))
+        ops, keys, vals = gen_workload(n, conflict_pct=30, seed=80 + phase)
+        cli.replies.clear()
+        stats = cli.run_workload(ops, keys, vals, timeout_s=60)
+        assert stats["acked"] == n, (phase, stats)
+        assert stats["duplicates"] == 0, (phase, stats)
+        if victim not in h.servers:
+            h.start_replica(victim)
+        time.sleep(0.3)
+    cli.close_conn()
