@@ -423,6 +423,12 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
             "vs_baseline": round(throughput / NORTH_STAR_PER_CHIP, 4),
             "device_ms_per_round": round(round_ms, 3),
             "dispatch_overhead_ms": round(k1_ms - round_ms, 1),
+            # per-dispatch walls: constant-shape dispatches must be
+            # constant-time — growth here is the round-2 pathology
+            # (dispatch-queue backup) resurfacing, visible without a
+            # rerun
+            "dispatch_wall_ms": [round((b - a) * 1e3, 1)
+                                 for a, b in zip(walls, walls[1:])],
             "rounds_per_dispatch": k,
             "p50_quorum_decision_ms": round(p50, 3),
             "p99_quorum_decision_ms": round(p99, 3),
