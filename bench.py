@@ -96,19 +96,18 @@ def _probe_backend(timeout_s: float = 120.0) -> str | None:
             return None
 
 
-def _devices_watchdogged(jax, fail_msg: str, timeout_s: float):
-    """In-process jax.devices() under a watchdog thread: a wedged
-    tunnel hangs init while HOLDING the global backend lock, and the
-    only honest outcome then is a structured failure record."""
-    box: list = []
-    t = threading.Thread(target=lambda: box.append(jax.devices()),
-                         daemon=True)
-    t.start()
-    t.join(timeout=timeout_s)
-    if not box:
-        _failure("backend-init", fail_msg)
-        sys.exit(0)
-    return box[0]
+def _devices_main_thread(jax):
+    """In-process jax.devices() on the MAIN thread, no watchdog.
+
+    Round-4 finding: the axon backend HANGS when initialized from a
+    non-main thread (a bare main-thread ``jax.devices()`` succeeds in
+    ~2s while the same call in a watchdog thread blocks forever) — so
+    the round-3 watchdog design *caused* the init failures it was
+    guarding against, and each aborted attempt wedged the relay for
+    minutes. Hang protection belongs to the PARENT: measure() always
+    runs as a child of main()'s ladder (subprocess timeout + kill), so
+    a blocking init here is safe and honest."""
+    return jax.devices()
 
 
 def _init_backend(retries: int = 2, timeout_s: float = 120.0):
@@ -134,17 +133,14 @@ def _init_backend(retries: int = 2, timeout_s: float = 120.0):
         if want.startswith("cpu"):
             return jax.devices()
         # explicit non-cpu platform (the tunnel env exports
-        # JAX_PLATFORMS=axon): watchdogged so the ladder driver gets a
-        # fast structured failure instead of burning the child timeout
-        return _devices_watchdogged(jax, f"{want} init hung",
-                                    timeout_s + 60)
+        # JAX_PLATFORMS=axon): main-thread init; the ladder driver's
+        # child timeout handles a genuine hang
+        return _devices_main_thread(jax)
 
     if os.environ.get("MP_BENCH_PROBED"):
         # the ladder driver probed this backend seconds ago; skip the
-        # redundant subprocess init (expensive over the tunnel) and go
-        # straight to the watchdogged in-process init
-        return _devices_watchdogged(
-            jax, "init hung after driver probe", timeout_s + 60)
+        # redundant subprocess init (expensive over the tunnel)
+        return _devices_main_thread(jax)
 
     ok = False
     for attempt in range(retries):
@@ -165,8 +161,7 @@ def _init_backend(retries: int = 2, timeout_s: float = 120.0):
             sys.exit(0)
         return jax.devices()
 
-    return _devices_watchdogged(
-        jax, "in-process init hung after live probe", timeout_s + 60)
+    return _devices_main_thread(jax)
 
 
 def _latency_rounds(uptos, crts, round_ms):
@@ -531,13 +526,19 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
 
 def main() -> None:
     """Shape-ladder driver: run measure() in a child process per
-    attempt, falling back to smaller shapes when the big one crashes
-    the TPU worker or hangs the tunnel (both observed under axon).
+    attempt, CLIMBING from the smallest shape to the north-star shape
+    and emitting the record of the largest shape that succeeded.
 
-    The child prints the JSON record on stdout; the driver relays the
-    LAST stdout line. A child that dies/hangs/lands on an unintended
-    platform triggers the next rung after a recovery pause (the worker
-    takes minutes to come back after a crash)."""
+    Round-3 ordered the ladder big-first and got nothing: the 1M-shape
+    warmup crashed the remote TPU worker outright and it never
+    respawned, so the smaller rungs never ran and the round's headline
+    was 0. Climbing secures a valid (if smaller) TPU record FIRST, so
+    a worker crash at a bigger rung costs only the bigger rung. The
+    child prints the JSON record on stdout; a child that dies/hangs/
+    lands on an unintended platform ends the climb (after a recovery
+    pause and one more probe gate, the next-bigger rung would face the
+    same dead worker — and the secured record must not be risked on
+    wedging the driver)."""
     import os
     import subprocess
 
@@ -551,11 +552,11 @@ def main() -> None:
         return
 
     ladder = [
-        (256, 4096, 512, 32),  # 1,048,576 concurrent (north-star)
-        (256, 4096, 512, 8),   # same shape, shorter fused scan
+        (64, 2048, 256, 16),   # 131,072 concurrent — secure this first
         (128, 4096, 512, 16),  # 524,288 (round-2 scale)
-        (64, 2048, 256, 16),   # 131,072
+        (256, 4096, 512, 32),  # 1,048,576 (north-star shape)
     ]
+    best: str | None = None
     last_fail = "no attempts ran"
     for i, shape in enumerate(ladder):
         # wait for a live non-cpu backend before burning a child
@@ -586,25 +587,32 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             last_fail = f"shape {shape}: child hung > 2400s"
             _progress(last_fail)
-            continue
+            break
         lines = [ln for ln in proc.stdout.decode().splitlines()
                  if ln.strip().startswith("{")]
         if proc.returncode != 0 or not lines:
             last_fail = f"shape {shape}: child rc={proc.returncode}"
             _progress(last_fail)
-            time.sleep(120)  # crashed worker: give it time to respawn
-            continue
-        rec = json.loads(lines[-1])
+            break
+        try:
+            rec = json.loads(lines[-1])
+        except json.JSONDecodeError:
+            # truncated child stdout (worker wedging mid-write) must
+            # not crash the driver past an already-secured record
+            last_fail = f"shape {shape}: unparseable child record"
+            _progress(last_fail)
+            break
         if rec.get("error") or rec.get("platform") in ("cpu", "none"):
-            # backend fell back to CPU / run failed inside the child:
-            # retry a smaller rung after recovery (a CPU number must
-            # never masquerade as the TPU headline)
+            # backend fell back to CPU / run failed inside the child
+            # (a CPU number must never masquerade as the TPU headline)
             last_fail = (f"shape {shape}: "
                          f"{rec.get('error') or rec.get('platform')}")
             _progress(last_fail)
-            time.sleep(120)
-            continue
-        print(lines[-1])
+            break
+        best = lines[-1]
+        _progress(f"rung {shape} ok: {rec['value']:.0f} inst/s — climbing")
+    if best is not None:
+        print(best)
         return
 
     # Every rung failed (wedged tunnel / repeated worker crashes). The

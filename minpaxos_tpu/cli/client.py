@@ -49,6 +49,32 @@ def _propose_retrying(cli, cmd_ids, ops, keys, vals,
             cli._failover()  # sleeps 0.5s itself when nothing accepts
 
 
+def _propose_until_acked(cli, cmd_ids, ops, keys, vals,
+                         timeout_s: float) -> bool:
+    """Propose + wait for the ack, failing over on BOTH connection
+    errors AND no-ack. A non-leader REJECTS proposals without any
+    socket error (ProposeReplyTS{OK:FALSE, Leader} — the reply sets
+    cli.leader_hint), so an error-only retry loop would wait out its
+    whole budget measuring nothing; re-proposing with the SAME cmd_id
+    through ``_failover`` (hint first) is the clientretry semantics
+    the closed-loop driver already uses."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            cli.propose(cmd_ids, ops, keys, vals)
+        except OSError:
+            if time.monotonic() >= deadline:
+                return False
+            cli._failover()
+            continue
+        left = deadline - time.monotonic()
+        if cli.wait(cmd_ids, timeout_s=max(min(1.0, left), 0.05)):
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        cli._failover()  # rejected or lost: re-route via the hint
+
+
 def _print_tot(counts, window=50):
     """Smoothed ops/s per 10ms bucket over a 50-bucket moving window
     (clienttot/client.go:278-300)."""
@@ -68,6 +94,11 @@ def main(argv=None) -> None:
     p.add_argument("-q", type=int, default=1000, help="requests per round")
     p.add_argument("-r", type=int, default=1, help="rounds")
     p.add_argument("-c", type=int, default=0, help="conflict percent")
+    p.add_argument("-sr", type=int, default=100000,
+                   help="key range (reference clientlat -sr). Size it "
+                        "below the servers' KV capacity (kv_pow2): the "
+                        "runtime fail-stops on table saturation rather "
+                        "than silently dropping acknowledged writes")
     p.add_argument("-z", type=float, default=0.0, help="Zipfian s (0=uniform)")
     p.add_argument("-w", type=int, default=100, help="write percent")
     p.add_argument("-check", action="store_true",
@@ -92,8 +123,8 @@ def main(argv=None) -> None:
     t_all = time.monotonic()
     for rnd in range(args.r):
         ops, keys, vals = gen_workload(
-            args.q, conflict_pct=args.c, zipf_s=args.z, write_pct=args.w,
-            seed=42 + rnd)
+            args.q, conflict_pct=args.c, key_range=args.sr, zipf_s=args.z,
+            write_pct=args.w, seed=42 + rnd)
         if args.lat:
             # clientlat mode: one outstanding request, per-op latency,
             # UNIQUE cmd_ids (a reused id would match a stale reply);
@@ -103,11 +134,9 @@ def main(argv=None) -> None:
             for i in range(args.q):
                 cid = np.asarray([i])
                 t0 = time.monotonic()
-                if not _propose_retrying(cli, cid, ops[i:i + 1],
-                                         keys[i:i + 1], vals[i:i + 1],
-                                         args.timeout):
-                    continue  # cluster unreachable for the whole budget
-                if cli.wait(cid, timeout_s=args.timeout):
+                if _propose_until_acked(cli, cid, ops[i:i + 1],
+                                        keys[i:i + 1], vals[i:i + 1],
+                                        args.timeout):
                     lats.append(time.monotonic() - t0)
                     total_acked += 1
             if lats:
@@ -134,11 +163,16 @@ def main(argv=None) -> None:
                     time.sleep(next_t - now)
                 for cid in idx:
                     send_ts[int(cid)] = time.monotonic()
-                # one failover retry, bounded: open-loop pacing must
-                # not block indefinitely; commands lost here are
-                # re-sent by the straggler sweep below
+                # bounded failover retries: open-loop pacing must not
+                # block indefinitely, but the budget tracks -timeout
+                # (an election longer than a fixed 2s would drop whole
+                # paced batches and skew the sample via the straggler
+                # sweep's original-send_ts resends); commands lost here
+                # are still re-sent by the straggler sweep below
                 _propose_retrying(cli, idx, ops[idx], keys[idx],
-                                  vals[idx], timeout_s=2.0)
+                                  vals[idx],
+                                  timeout_s=min(max(2.0, args.timeout / 4.0),
+                                                args.timeout))
                 next_t += pace
             # stragglers: re-send unacked through failover (the paced
             # send is fire-and-forget; a dropped conn would otherwise

@@ -80,9 +80,14 @@ def main(argv=None) -> None:
 
     protocol = ("mencius" if args.mencius
                 else "classic" if args.classic else "minpaxos")
+    # kv_pow2=20 (1M slots, ~25 MB): comfortably above the client's
+    # default -sr key range (100k) — the runtime FAIL-STOPS on table
+    # saturation rather than silently dropping acknowledged writes, so
+    # the default server capacity must dominate the default client key
+    # space (the reference's Go map just grows, state.go:33-36)
     cfg = MinPaxosConfig(
         n_replicas=len(nodes), window=args.window, inbox=args.inbox,
-        exec_batch=args.inbox, kv_pow2=16,
+        exec_batch=args.inbox, kv_pow2=20,
         catchup_rows=256, recovery_rows=256,
         explicit_commit=args.classic and not args.mencius)
     prof = cProfile.Profile() if args.cpuprofile else None

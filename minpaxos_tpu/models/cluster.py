@@ -134,18 +134,26 @@ def pack_reply_key(client_id, cmd_id) -> np.ndarray:
 
 class KeyBuf:
     """Append-only packed-key buffer with amortized-doubling growth:
-    O(1) amortized append, zero-copy view for ``np.isin``. (A
-    chunk-list concatenated on read would re-copy the whole proposal
-    history every time a collect follows a propose.) Keys are never
-    pruned: a key must survive its reply so late duplicate executions
-    (e.g. post-recovery replay) still surface as ``duplicate`` entries
-    in the reply log — the safety tests assert on exactly that."""
+    O(1) amortized append, zero-copy view. (A chunk-list concatenated
+    on read would re-copy the whole proposal history every time a
+    collect follows a propose.) Keys are never pruned: a key must
+    survive its reply so late duplicate executions (e.g. post-recovery
+    replay) still surface as ``duplicate`` entries in the reply log —
+    the safety tests assert on exactly that.
 
-    __slots__ = ("_arr", "_n")
+    Membership checks go through ``contains``, which keeps a sorted
+    snapshot refreshed only when appends happened and probes it with
+    ``np.searchsorted`` — ``np.isin`` against ``view()`` would re-sort
+    the whole proposal history on EVERY collect call, an O(n log n)
+    per-tick cost that grows with the cluster's lifetime."""
+
+    __slots__ = ("_arr", "_n", "_sorted", "_sorted_n")
 
     def __init__(self) -> None:
         self._arr = np.empty(256, np.int64)
         self._n = 0
+        self._sorted = self._arr[:0]
+        self._sorted_n = 0
 
     def append(self, keys) -> None:
         keys = np.atleast_1d(keys)
@@ -159,6 +167,17 @@ class KeyBuf:
 
     def view(self) -> np.ndarray:
         return self._arr[: self._n]
+
+    def contains(self, keys: np.ndarray) -> np.ndarray:
+        """Vectorized membership: bool mask over ``keys``."""
+        if self._sorted_n != self._n:
+            self._sorted = np.sort(self._arr[: self._n])
+            self._sorted_n = self._n
+        v = self._sorted
+        if not len(v):
+            return np.zeros(len(np.atleast_1d(keys)), bool)
+        pos = np.searchsorted(v, keys)
+        return v[np.minimum(pos, len(v) - 1)] == keys
 
 
 def collect_exec_replies(cl, execr: ExecResult, *,
@@ -195,7 +214,7 @@ def collect_exec_replies(cl, execr: ExecResult, *,
             cand &= ~((op_n == 0) & (mid_n == 0))
         if not cand.any():
             continue
-        cand &= np.isin(pack_reply_key(cid_n, mid_n), keys.view())
+        cand &= keys.contains(pack_reply_key(cid_n, mid_n))
         idx = np.nonzero(cand)[0]
         if not idx.size:
             continue

@@ -63,6 +63,7 @@ from minpaxos_tpu.models.minpaxos import (
     _rel,
     make_ballot,
 )
+from minpaxos_tpu.ops.ackruns import compress_ack_runs, range_vote_coverage
 from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
 from minpaxos_tpu.ops.scan import commit_frontier, segmented_scan_max
 from minpaxos_tpu.wire.messages import MsgKind, Op
@@ -264,13 +265,25 @@ def mencius_step_impl(
         & (state.cmd_id[rel_a_safe] == inbox.cmd_id)
         & (state.client_id[rel_a_safe] == inbox.client_id)
     )
+    # run-length compressed acks (same scheme as models/minpaxos.py
+    # step 2; cmd_id = run length -> wire `count`). Owner broadcasts
+    # stride by R so steady-state runs are length 1, but takeover
+    # re-drives and catch-up COMMIT-answer acks cover consecutive
+    # slots and compress fully. The echoed ballot joins the run key —
+    # unlike MinPaxos's constant default_ballot reply, Mencius echoes
+    # the accept's own ballot, which can vary across one inbox.
+    ack_ok_row = acc_ok | acc_dup_ok
+    run_start, run_len = compress_ack_runs(
+        is_accept, inbox.src, inbox.inst, ack_ok_row, ballot=inbox.ballot)
     out = out._replace(
-        kind=jnp.where(is_accept, int(MsgKind.ACCEPT_REPLY), out.kind),
+        kind=jnp.where(is_accept,
+                       jnp.where(run_start, int(MsgKind.ACCEPT_REPLY), 0),
+                       out.kind),
         src=jnp.where(is_accept, me, out.src),
         inst=jnp.where(is_accept, inbox.inst, out.inst),
         ballot=jnp.where(is_accept, inbox.ballot, out.ballot),
-        op=jnp.where(is_accept, (acc_ok | acc_dup_ok).astype(jnp.int32),
-                     out.op),
+        op=jnp.where(is_accept, ack_ok_row.astype(jnp.int32), out.op),
+        cmd_id=jnp.where(is_accept, run_len, out.cmd_id),
         last_committed=jnp.where(is_accept, state.committed_upto,
                                  out.last_committed),
     )
@@ -337,19 +350,21 @@ def mencius_step_impl(
     )
 
     # ---- 5. ACCEPT_REPLY vote counting (handleAcceptReply :692-742) --
-    # Acks count for slots I'm DRIVING: my owned slots (ballot 0) and
-    # takeover slots whose current ballot carries my id in its low bits
-    # (make_ballot(counter, me) — successor-driven slots are not owned)
-    rel_r, in_win_r = _rel(state, inbox.inst, S)
-    rel_r_safe = jnp.minimum(rel_r, S - 1)
-    drv = (jnp.mod(inbox.inst, R) == me) | (
-        (state.ballot[rel_r_safe] > 0)
-        & (jnp.mod(state.ballot[rel_r_safe], 16) == me))
-    ar_ok = is_areply & in_win_r & (inbox.op > 0) & drv
+    # One reply row acks [inst, inst + count) (run-length compression;
+    # count in cmd_id). Ranges expand to per-slot coverage via a
+    # per-sender difference array + prefix sum, then gate on the slots
+    # I'm DRIVING: my owned slots (ballot 0) and takeover slots whose
+    # current ballot carries my id in its low bits (make_ballot(counter,
+    # me) — successor-driven slots are not owned). The per-slot gate is
+    # what keeps a stale ack from ever counting toward a slot another
+    # replica is driving.
+    ar_ok = is_areply & (inbox.op > 0)
+    vote_cov = range_vote_coverage(ar_ok, inbox.src, inbox.inst,
+                                   inbox.cmd_id, state.window_base, S, R)
+    drv_slot = own_mask | (
+        (state.ballot > 0) & (jnp.mod(state.ballot, 16) == me))
     state = state._replace(
-        votes=state.votes.at[
-            jnp.where(ar_ok, rel_r, S), jnp.clip(inbox.src, 0, R - 1)
-        ].set(True, mode="drop"))
+        votes=state.votes | (vote_cov & drv_slot[:, None]))
 
     # ---- 6. COMMIT rows (explicit commit transfer, bcastCommit) ----
     rel_c, in_win_c = _rel(state, inbox.inst, S)
@@ -546,12 +561,14 @@ def mencius_step_impl(
     # successor-priority avoids ballot duels, but a revived laggard's
     # frontier view is private — the blocking owner's successor (a live
     # replica, far ahead) will never sweep FOR it. After a long stall
-    # any stuck replica sweeps its own blocked range; concurrent
-    # sweepers are ordered by their takeover ballots like any
-    # per-instance phase-1 competition.
+    # any stuck replica sweeps its own blocked range, with the
+    # threshold staggered by replica id so that under a global stall
+    # competing sweepers start serialized instead of dueling ballots
+    # on the same tick (the reference staggers forceCommit the same
+    # way, mencius.go:878-886 "50+Id").
     do_tk = (in_flight
              & ((i_am_successor & (state.stall_ticks >= cfg.noop_delay))
-                | (state.stall_ticks >= 4 * cfg.noop_delay)))
+                | (state.stall_ticks >= (4 + me) * cfg.noop_delay)))
     # fresh takeover ballot when starting a new takeover episode
     new_tb = make_ballot(state.max_recv_ballot // 16 + 1, me)
     tb = jnp.where(do_tk & (state.takeover_ballot < 0), new_tb,
@@ -757,7 +774,7 @@ def mencius_step_impl(
             executed=slide(state.executed, False),
             window_base=state.window_base + shift,
         )
-    return state, Outbox(msgs=out, dst=dst), execr
+    return state, Outbox(msgs=out, dst=dst, acked=ack_ok_row), execr
 
 
 mencius_step = jax.jit(mencius_step_impl, static_argnums=0,

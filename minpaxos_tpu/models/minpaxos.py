@@ -37,6 +37,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from minpaxos_tpu.ops.ackruns import compress_ack_runs, range_vote_coverage
 from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
 from minpaxos_tpu.ops.scan import commit_frontier
 from minpaxos_tpu.wire.messages import MsgKind
@@ -125,10 +126,20 @@ class Outbox(NamedTuple):
     dst == -1 means broadcast to all peers; otherwise a replica id.
     PROPOSE_REPLY rows are addressed to clients (host resolves the
     connection from client_id).
+
+    ACCEPT_REPLY rows are run-length compressed: only the first row of
+    each maximal contiguous (sender, ok, consecutive inst) run is live,
+    with cmd_id carrying the run length (the wire ``count``,
+    minpaxosproto.go:75-80); the other rows of the run are padding.
+    ``acked`` therefore exists as the durability hook: bool per INBOX
+    row, True where an inbox ACCEPT row was accepted (or re-acked as
+    identical-committed) this step — the host's _persist reads it
+    instead of matching outbox rows 1:1 (runtime/replica.py).
     """
 
     msgs: MsgBatch
     dst: jnp.ndarray  # i32[M]
+    acked: jnp.ndarray  # bool[M_in] over inbox rows
 
 
 class ExecResult(NamedTuple):
@@ -443,14 +454,28 @@ def replica_step_impl(
         & (state.cmd_id[rel_a_safe] == inbox.cmd_id)
         & (state.client_id[rel_a_safe] == inbox.client_id)
     )
-    # ack every ACCEPT row (ok=0 NACK carries our promised ballot)
+    # ack every ACCEPT row (ok=0 NACK carries our promised ballot),
+    # run-length compressed: one reply row per maximal contiguous
+    # (sender, ok, consecutive inst) run instead of one per slot, with
+    # cmd_id = run length (wire `count`, minpaxosproto.go:75-80). The
+    # leader consumes the range in step 6. This kills the round-3
+    # ack-row explosion — (R-1)*p per-slot ack rows per round through
+    # the routing fabric collapse to ~1 per follower, which is what
+    # lets the inbox capacity (and every [M]-shaped computation in this
+    # kernel) be sized to ~p instead of ~R*p.
+    ack_ok_row = acc_ok | acc_com_match
+    run_start, run_len = compress_ack_runs(
+        is_accept, inbox.src, inbox.inst, ack_ok_row)
     out = out._replace(
-        kind=jnp.where(is_accept, int(MsgKind.ACCEPT_REPLY), out.kind),
+        kind=jnp.where(is_accept,
+                       jnp.where(run_start, int(MsgKind.ACCEPT_REPLY), 0),
+                       out.kind),
         src=jnp.where(is_accept, state.me, out.src),
         inst=jnp.where(is_accept, inbox.inst, out.inst),
         ballot=jnp.where(is_accept, state.default_ballot, out.ballot),
-        op=jnp.where(is_accept, (acc_ok | acc_com_match).astype(jnp.int32),
+        op=jnp.where(is_accept, ack_ok_row.astype(jnp.int32),
                      out.op),  # op = ok flag
+        cmd_id=jnp.where(is_accept, run_len, out.cmd_id),  # run length
         last_committed=jnp.where(is_accept, state.committed_upto, out.last_committed),
     )
     dst = jnp.where(is_accept, inbox.src, dst)
@@ -626,10 +651,17 @@ def replica_step_impl(
     dst = jnp.where(fits, -1, jnp.where(reject, -2, dst))  # -2 = to client
 
     # ---- 6. ACCEPT_REPLY (handleAcceptReply :1014-1064) ----
-    rel_r, in_win_r = _rel(state, inbox.inst, S)
-    ar_ok = is_accept_reply & in_win_r & (inbox.op > 0) & state.is_leader \
+    # One reply row acks the RANGE [inst, inst + count) (count in
+    # cmd_id — the run-length compression emitted by step 2 / carried
+    # by the wire `count` field). The range becomes per-slot votes via
+    # a per-sender difference array + prefix sum: +1 at the range
+    # start, -1 past its end, cumsum > 0 = covered. Rows predating
+    # compression (cmd_id == 0) count as single-slot acks. Ranges
+    # clipped to the window contribute their resident part.
+    ar_ok = is_accept_reply & (inbox.op > 0) & state.is_leader \
         & (inbox.ballot == state.default_ballot)
-    tgt_r = jnp.where(ar_ok, rel_r, S)
+    vote_cov = range_vote_coverage(ar_ok, inbox.src, inbox.inst,
+                                   inbox.cmd_id, state.window_base, S, R)
     reply_src = jnp.where(is_accept_reply | is_prep_reply,
                           jnp.clip(inbox.src, 0, R - 1), R)
     # peer_commits ADOPTS the batch-max report per peer rather than
@@ -642,8 +674,7 @@ def replica_step_impl(
         inbox.last_committed)
     replied = pc_seen[:R] > -(2 ** 30)
     state = state._replace(
-        votes=state.votes.at[tgt_r, jnp.clip(inbox.src, 0, R - 1)].set(
-            True, mode="drop"),
+        votes=state.votes | vote_cov,
         max_recv_ballot=jnp.maximum(
             state.max_recv_ballot,
             jnp.max(jnp.where(is_accept_reply, inbox.ballot, NO_BALLOT))),
@@ -950,7 +981,7 @@ def replica_step_impl(
             pvotes=slide(state.pvotes, False),
             window_base=state.window_base + shift,
         )
-    return state, Outbox(msgs=out, dst=dst), execr
+    return state, Outbox(msgs=out, dst=dst, acked=ack_ok_row), execr
 
 
 # Single-replica entry point used by the host runtime (runtime/replica.py).

@@ -1,6 +1,13 @@
 from minpaxos_tpu.ops.scan import segmented_scan_max, exclusive_segmented_scan_max, commit_frontier
 from minpaxos_tpu.ops.packed import split_i64, join_i64
-from minpaxos_tpu.ops.kvstore import KVState, kv_init, kv_lookup, kv_apply_batch
+from minpaxos_tpu.ops.kvstore import (
+    KVState,
+    kv_init,
+    kv_lookup,
+    kv_lookup_lanes,
+    kv_apply_batch,
+    kv_apply_batch_lanes,
+)
 
 __all__ = [
     "segmented_scan_max",
@@ -11,5 +18,7 @@ __all__ = [
     "KVState",
     "kv_init",
     "kv_lookup",
+    "kv_lookup_lanes",
     "kv_apply_batch",
+    "kv_apply_batch_lanes",
 ]

@@ -1,0 +1,79 @@
+"""Run-length ack compression + range vote coverage (shared kernels).
+
+The ack-row explosion fix (round 4): a replica acking p contiguous
+ACCEPT rows emits ONE live ACCEPT_REPLY row whose cmd_id carries the
+run length (the wire ``count``, reference minpaxosproto.go:75-80
+AcceptReply batching), and the driving replica consumes the range with
+a per-sender difference array + prefix sum instead of one scatter per
+slot. Both halves live here so the subtle index arithmetic cannot
+drift between the MinPaxos and Mencius kernels — they MUST stay in
+lockstep or ack emission desynchronizes from vote consumption.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _shift1(x: jnp.ndarray, fill) -> jnp.ndarray:
+    """x shifted right by one row (previous-row view), fill at row 0."""
+    return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+
+def compress_ack_runs(is_accept: jnp.ndarray, src: jnp.ndarray,
+                      inst: jnp.ndarray, ok: jnp.ndarray,
+                      ballot: jnp.ndarray | None = None):
+    """Split ACCEPT rows into maximal runs of consecutive instances.
+
+    A row starts a new run when the previous row is not an ACCEPT, has
+    a different sender or ok flag, is not the immediately preceding
+    instance, or (when ``ballot`` is given — Mencius echoes the
+    accept's own ballot into its reply, so it is part of the reply row)
+    carries a different ballot.
+
+    Returns (run_start bool[M], run_len i32[M]) where run_len is the
+    total run length at EVERY row of the run (callers publish it on the
+    start row; other rows become padding).
+    """
+    m = is_accept.shape[0]
+    same_prev = (
+        _shift1(is_accept, False)
+        & (_shift1(src, jnp.int32(-7)) == src)
+        & (_shift1(ok, False) == ok)
+        & (_shift1(inst, jnp.int32(-7)) + 1 == inst))
+    if ballot is not None:
+        same_prev = same_prev & (_shift1(ballot, jnp.int32(-7)) == ballot)
+    run_start = is_accept & ~same_prev
+    rid = jnp.cumsum(run_start.astype(jnp.int32)) - 1
+    run_len = jnp.zeros(m + 1, jnp.int32).at[
+        jnp.where(is_accept, rid, m)].add(1, mode="drop")
+    return run_start, run_len[jnp.clip(rid, 0, m)]
+
+
+def range_vote_coverage(valid: jnp.ndarray, src: jnp.ndarray,
+                        inst: jnp.ndarray, count: jnp.ndarray,
+                        window_base, window: int, n_replicas: int):
+    """Per-slot vote coverage from range-ack rows.
+
+    Each valid row acks the instance range [inst, inst + count); ranges
+    clip to the resident window (partial coverage for ranges straddling
+    a slide — legal: votes are facts about slots). Implementation: a
+    per-sender (R, S+1) difference array — +1 at the range start, -1
+    one past its end (column S, the clip ceiling, is sliced off after
+    the prefix sum, which is what makes end-at-window-edge exact) —
+    then cumsum > 0.
+
+    Returns bool[S, R], ready to OR into a votes table.
+    """
+    s, r = window, n_replicas
+    cnt = jnp.maximum(count, 1)  # pre-compression rows carry 0
+    lo_rel = jnp.clip(inst - window_base, 0, s)
+    hi_rel = jnp.clip(inst + cnt - window_base, 0, s)
+    vrow = valid & (hi_rel > lo_rel)
+    src_c = jnp.clip(src, 0, r - 1)
+    vd = jnp.zeros((r, s + 1), jnp.int32)
+    vd = vd.at[jnp.where(vrow, src_c, r),
+               jnp.where(vrow, lo_rel, s)].add(1, mode="drop")
+    vd = vd.at[jnp.where(vrow, src_c, r),
+               jnp.where(vrow, hi_rel, s)].add(-1, mode="drop")
+    return (jnp.cumsum(vd, axis=1)[:, :s] > 0).T

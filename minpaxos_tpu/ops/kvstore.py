@@ -20,10 +20,17 @@ max-scan (ops/scan.py) over the sorted order; final writers per key
 parallel claim loop. Everything is fixed-shape and branch-free, so XLA
 compiles it once per batch size.
 
-Keys/values are 64-bit on the wire and (hi, lo) i32 lane pairs on
-device (ops/packed.py). Storage dtype is a config knob: this module is
-also where the reference's 1KB-value build variant (state.go.1k,
-``Value [128]int64``) generalizes — see ``VAL_LANES`` below.
+Keys are 64-bit on the wire and (hi, lo) i32 lane pairs on device
+(ops/packed.py). Values are a ``[*, L]`` i32 lane axis: the engine
+(``kv_init`` / ``kv_lookup_lanes`` / ``kv_apply_batch_lanes``) is
+generic over L and tested at L=256 — the reference's 1KB build variant
+(state.go.1k:15, ``Value [128]int64`` = 256 i32 lanes). The consensus
+log and wire schemas instantiate L=2 (one i64 value, statemarsh.go:8-21)
+through the ``kv_lookup`` / ``kv_apply_batch`` wrappers below; widening
+THOSE is a deployment-wide schema swap, exactly like the reference
+swapping state.go for state.go.1k at build time (wire/messages.py
+design note), and the seam is these two wrappers plus the ``val``
+columns in wire/messages.py.
 """
 
 from __future__ import annotations
@@ -42,8 +49,9 @@ from minpaxos_tpu.wire.messages import Op
 # slot instead of consuming capacity.
 EMPTY, LIVE, DELETED = 0, 1, 2
 
-# Number of i32 lanes per value. 2 = the reference's default 8-byte
-# value; 256 would reproduce the 1KB build variant (state.go.1k:15).
+# i32 lanes per value on the consensus path: one 8-byte wire value
+# (statemarsh.go:8-21). The engine itself is lane-generic — see module
+# docstring and kv_init(val_lanes=...).
 VAL_LANES = 2
 
 
@@ -52,26 +60,26 @@ class KVState(NamedTuple):
 
     key_hi: jnp.ndarray  # i32[C]
     key_lo: jnp.ndarray  # i32[C]
-    val_hi: jnp.ndarray  # i32[C]
-    val_lo: jnp.ndarray  # i32[C]
+    val: jnp.ndarray  # i32[C, L]
     slot: jnp.ndarray  # i32[C]: EMPTY / LIVE / DELETED
     dropped: jnp.ndarray  # i32 scalar: inserts lost to a full table
 
 
-def kv_init(capacity_pow2: int) -> KVState:
+def kv_init(capacity_pow2: int, val_lanes: int = VAL_LANES) -> KVState:
     c = 1 << capacity_pow2
     z = jnp.zeros(c, dtype=jnp.int32)
-    return KVState(z, z, z, z, z, jnp.int32(0))
+    return KVState(z, z, jnp.zeros((c, val_lanes), jnp.int32), z,
+                   jnp.int32(0))
 
 
 def _probe_pos(h: jnp.ndarray, t: jnp.ndarray, mask: int) -> jnp.ndarray:
     return ((h + t.astype(jnp.uint32)) & jnp.uint32(mask)).astype(jnp.int32)
 
 
-def kv_lookup(kv: KVState, k_hi: jnp.ndarray, k_lo: jnp.ndarray,
-              valid: jnp.ndarray | None = None):
-    """Batched probe: returns (found bool[B], v_hi i32[B], v_lo i32[B])."""
-    c = kv.key_hi.shape[0]
+def kv_lookup_lanes(kv: KVState, k_hi: jnp.ndarray, k_lo: jnp.ndarray,
+                    valid: jnp.ndarray | None = None):
+    """Batched probe: returns (found bool[B], v i32[B, L])."""
+    c, lanes = kv.val.shape
     mask = c - 1
     h = pair_hash(k_hi, k_lo)
     b = k_hi.shape[0]
@@ -79,11 +87,11 @@ def kv_lookup(kv: KVState, k_hi: jnp.ndarray, k_lo: jnp.ndarray,
         valid = jnp.ones(b, dtype=bool)
 
     def cond(carry):
-        t, done, _, _, _ = carry
+        t, done, _, _ = carry
         return (~done).any() & (t < c)
 
     def body(carry):
-        t, done, found, v_hi, v_lo = carry
+        t, done, found, v = carry
         pos = _probe_pos(h, jnp.full(b, t, jnp.int32), mask)
         s = kv.slot[pos]
         key_match = (s != EMPTY) & (kv.key_hi[pos] == k_hi) & (
@@ -91,34 +99,40 @@ def kv_lookup(kv: KVState, k_hi: jnp.ndarray, k_lo: jnp.ndarray,
         empty = s == EMPTY
         hit = ~done & key_match & (s == LIVE)
         found = found | hit
-        v_hi = jnp.where(hit, kv.val_hi[pos], v_hi)
-        v_lo = jnp.where(hit, kv.val_lo[pos], v_lo)
+        v = jnp.where(hit[:, None], kv.val[pos], v)
         done = done | key_match | empty
-        return t + 1, done, found, v_hi, v_lo
+        return t + 1, done, found, v
 
     init = (
         jnp.int32(0),
         ~valid,
         jnp.zeros(b, dtype=bool),
-        jnp.zeros(b, dtype=jnp.int32),
-        jnp.zeros(b, dtype=jnp.int32),
+        jnp.zeros((b, lanes), dtype=jnp.int32),
     )
-    _, _, found, v_hi, v_lo = jax.lax.while_loop(cond, body, init)
-    return found, v_hi, v_lo
+    _, _, found, v = jax.lax.while_loop(cond, body, init)
+    return found, v
 
 
-def kv_insert_unique(kv: KVState, k_hi, k_lo, v_hi, v_lo, delete, valid) -> KVState:
+def kv_lookup(kv: KVState, k_hi: jnp.ndarray, k_lo: jnp.ndarray,
+              valid: jnp.ndarray | None = None):
+    """2-lane (single-i64-value) probe: (found, v_hi, v_lo)."""
+    found, v = kv_lookup_lanes(kv, k_hi, k_lo, valid)
+    return found, v[:, 0], v[:, 1]
+
+
+def kv_insert_unique(kv: KVState, k_hi, k_lo, v, delete, valid) -> KVState:
     """Insert/overwrite/delete a batch of rows with DISTINCT keys.
 
-    Parallel claim loop: each pending row probes its chain; rows that
-    reach an empty or key-matching slot scatter-min their row index
-    into a claim array; winners write, losers advance. Terminates in
-    at most C rounds (far fewer in practice at sane load factors).
-    DELETE marks the slot DELETED in place, keeping its key, so probe
-    chains never break and churn reuses the slot. Rows that exhaust
-    the table are counted in kv.dropped (callers should size
-    kv_pow2 above the distinct-key count; tests assert dropped == 0).
-    """
+    ``v`` is i32[B, L]. Parallel claim loop: each pending row probes
+    its chain; rows that reach an empty or key-matching slot
+    scatter-min their row index into a claim array; winners write,
+    losers advance. Terminates in at most C rounds (far fewer in
+    practice at sane load factors). DELETE marks the slot DELETED in
+    place, keeping its key, so probe chains never break and churn
+    reuses the slot. Rows that exhaust the table are counted in
+    kv.dropped (callers should size kv_pow2 above the distinct-key
+    count; the TCP runtime fail-stops on dropped > 0 —
+    runtime/replica.py)."""
     c = kv.key_hi.shape[0]
     mask = c - 1
     b = k_hi.shape[0]
@@ -146,8 +160,7 @@ def kv_insert_unique(kv: KVState, k_hi, k_lo, v_hi, v_lo, delete, valid) -> KVSt
         kv = kv._replace(
             key_hi=kv.key_hi.at[wpos].set(k_hi, mode="drop"),
             key_lo=kv.key_lo.at[wpos].set(k_lo, mode="drop"),
-            val_hi=kv.val_hi.at[wpos].set(v_hi, mode="drop"),
-            val_lo=kv.val_lo.at[wpos].set(v_lo, mode="drop"),
+            val=kv.val.at[wpos].set(v, mode="drop"),
             slot=kv.slot.at[wpos].set(new_slot, mode="drop"),
         )
         # losers and occupied-by-other rows advance their probe offset
@@ -159,14 +172,15 @@ def kv_insert_unique(kv: KVState, k_hi, k_lo, v_hi, v_lo, delete, valid) -> KVSt
     return kv._replace(dropped=kv.dropped + still_pending.sum())
 
 
-def kv_apply_batch(kv: KVState, op, k_hi, k_lo, v_hi, v_lo, valid):
-    """Apply B commands in slot order; returns (kv', out_hi, out_lo, found).
+def kv_apply_batch_lanes(kv: KVState, op, k_hi, k_lo, v, valid):
+    """Apply B commands in slot order; returns (kv', out i32[B, L],
+    found bool[B]).
 
-    ``op`` follows wire Op codes. Outputs are in the original row order:
-    PUT echoes its value, GET returns the value visible at its slot
-    (found=False, 0 when absent), DELETE returns 0. RLOCK/WLOCK/NONE
-    are no-ops (the reference parses but never implements them,
-    state.go:12-19 vs :86-103).
+    ``op`` follows wire Op codes; ``v`` is i32[B, L]. Outputs are in
+    the original row order: PUT echoes its value, GET returns the value
+    visible at its slot (found=False, zeros when absent), DELETE
+    returns zeros. RLOCK/WLOCK/NONE are no-ops (the reference parses
+    but never implements them, state.go:12-19 vs :86-103).
     """
     b = op.shape[0]
     rows = jnp.arange(b, dtype=jnp.int32)
@@ -185,7 +199,7 @@ def kv_apply_batch(kv: KVState, op, k_hi, k_lo, v_hi, v_lo, valid):
 
     s_khi, s_klo, s_valid = g(k_hi), g(k_lo), g(valid)
     s_put, s_del, s_write = g(is_put), g(is_del), g(is_write)
-    s_vhi, s_vlo = g(v_hi), g(v_lo)
+    s_v = v[order]
 
     pos = jnp.arange(b, dtype=jnp.int32)
     seg_start = (pos == 0) | (s_khi != jnp.roll(s_khi, 1)) | (s_klo != jnp.roll(s_klo, 1)) \
@@ -197,23 +211,21 @@ def kv_apply_batch(kv: KVState, op, k_hi, k_lo, v_hi, v_lo, valid):
     has_prev = prev_w >= 0
     pw = jnp.where(has_prev, prev_w, 0)
     prev_present = has_prev & s_put[pw]
-    prev_vhi = s_vhi[pw]
-    prev_vlo = s_vlo[pw]
+    prev_v = s_v[pw]
 
     # pre-batch table state for rows with no in-batch predecessor
-    t_found, t_vhi, t_vlo = kv_lookup(kv, s_khi, s_klo, s_valid & ~has_prev)
+    t_found, t_v = kv_lookup_lanes(kv, s_khi, s_klo, s_valid & ~has_prev)
 
     eff_present = jnp.where(has_prev, prev_present, t_found)
-    eff_vhi = jnp.where(has_prev, jnp.where(prev_present, prev_vhi, 0), t_vhi)
-    eff_vlo = jnp.where(has_prev, jnp.where(prev_present, prev_vlo, 0), t_vlo)
+    eff_v = jnp.where(has_prev[:, None],
+                      jnp.where(prev_present[:, None], prev_v, 0), t_v)
 
-    out_hi_s = jnp.where(g(is_put), s_vhi, jnp.where(g(is_get), eff_vhi, 0))
-    out_lo_s = jnp.where(g(is_put), s_vlo, jnp.where(g(is_get), eff_vlo, 0))
+    out_s = jnp.where(g(is_put)[:, None], s_v,
+                      jnp.where(g(is_get)[:, None], eff_v, 0))
     found_s = jnp.where(g(is_get), eff_present, g(is_put))
 
     # scatter back to original row order
-    out_hi = jnp.zeros(b, jnp.int32).at[order].set(out_hi_s)
-    out_lo = jnp.zeros(b, jnp.int32).at[order].set(out_lo_s)
+    out = jnp.zeros_like(v).at[order].set(out_s)
     found = jnp.zeros(b, bool).at[order].set(found_s)
 
     # final writer per key = max write position in segment
@@ -225,6 +237,15 @@ def kv_apply_batch(kv: KVState, op, k_hi, k_lo, v_hi, v_lo, valid):
     is_final_writer = s_write & (pos == seg_total)
 
     kv = kv_insert_unique(
-        kv, s_khi, s_klo, s_vhi, s_vlo, delete=s_del, valid=is_final_writer
+        kv, s_khi, s_klo, s_v, delete=s_del, valid=is_final_writer
     )
-    return kv, out_hi, out_lo, found
+    return kv, out, found
+
+
+def kv_apply_batch(kv: KVState, op, k_hi, k_lo, v_hi, v_lo, valid):
+    """2-lane (single-i64-value) apply: (kv', out_hi, out_lo, found) —
+    the consensus kernels' entry point (models/minpaxos.py step 8,
+    models/mencius.py step 11)."""
+    v = jnp.stack([v_hi, v_lo], axis=1)
+    kv, out, found = kv_apply_batch_lanes(kv, op, k_hi, k_lo, v, valid)
+    return kv, out[:, 0], out[:, 1], found

@@ -11,10 +11,12 @@ channel-dispatch plumbing (genericsmr.go:402-446 and the *marsh.go
 files); here a 5000-row Accept frame becomes 5000 device rows with a
 handful of numpy column copies.
 
-AcceptReply compression: the device acks one row per slot; on the wire
-contiguous (inst, ballot, ok) runs collapse into a single row with a
-``count`` (like the reference's batched AcceptReply covering a whole
-Accept batch, minpaxosproto.go:75-80) and re-expand on receive.
+AcceptReply compression is kernel-native (round 4): the device emits
+one ACCEPT_REPLY row per contiguous run with the run length in cmd_id
+(like the reference's batched AcceptReply covering a whole Accept
+batch, minpaxosproto.go:75-80), and consumes ranges the same way — so
+this boundary maps count <-> cmd_id 1:1 in both directions with no
+expansion.
 """
 
 from __future__ import annotations
@@ -90,17 +92,16 @@ def frame_to_rows(buf: ColumnBuffer, kind: MsgKind, rows: np.ndarray,
                    key_hi=k_hi, key_lo=k_lo, val_hi=v_hi, val_lo=v_lo,
                    cmd_id=rows["cmd_id"], client_id=rows["client_id"])
     elif kind == MsgKind.ACCEPT_REPLY:
-        # expand (inst, count) runs back into per-slot rows
-        counts = np.maximum(rows["count"], 1)
-        total = int(counts.sum())
-        rep = np.repeat(np.arange(n), counts)
-        offs = np.arange(total) - np.repeat(
-            np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
-        buf.append(total, kind=k, src=rows["id"].astype(np.int32)[rep],
-                   ballot=rows["ballot"][rep],
-                   inst=rows["inst"][rep] + offs.astype(np.int32),
-                   last_committed=rows["last_committed"][rep],
-                   op=rows["ok"].astype(np.int32)[rep])
+        # (inst, count) runs pass straight through: the kernel consumes
+        # ranges natively (count rides the cmd_id column; vote coverage
+        # via difference array + prefix sum in step 6 / mencius step 5).
+        # The old per-slot re-expansion would undo the compression and
+        # re-inflate the inbox by the ack factor.
+        buf.append(n, kind=k, src=rows["id"].astype(np.int32),
+                   ballot=rows["ballot"], inst=rows["inst"],
+                   last_committed=rows["last_committed"],
+                   op=rows["ok"].astype(np.int32),
+                   cmd_id=np.maximum(rows["count"], 1).astype(np.int32))
     elif kind == MsgKind.PREPARE:
         buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
                    ballot=rows["ballot"],
@@ -140,19 +141,6 @@ def frame_to_rows(buf: ColumnBuffer, kind: MsgKind, rows: np.ndarray,
     # (transport/replica), never as device rows.
 
 
-def _runs(inst: np.ndarray, ballot: np.ndarray, ok: np.ndarray):
-    """Split per-slot ack rows into maximal contiguous runs."""
-    n = len(inst)
-    if n == 0:
-        return np.empty(0, np.int64), np.empty(0, np.int64)
-    brk = np.nonzero(
-        (inst[1:] != inst[:-1] + 1) | (ballot[1:] != ballot[:-1])
-        | (ok[1:] != ok[:-1]))[0] + 1
-    starts = np.concatenate([[0], brk])
-    ends = np.concatenate([brk, [n]])
-    return starts, ends
-
-
 def rows_to_frames(cols: dict, mask: np.ndarray) -> list[tuple[MsgKind, np.ndarray]]:
     """Convert masked outbox rows (one destination's worth) into wire
     frames, one frame per message kind present."""
@@ -173,16 +161,14 @@ def rows_to_frames(cols: dict, mask: np.ndarray) -> list[tuple[MsgKind, np.ndarr
                 cmd_id=sub["cmd_id"][m], client_id=sub["client_id"][m],
                 last_committed=sub["last_committed"][m])
         elif kind == MsgKind.ACCEPT_REPLY:
-            inst, ball, ok = sub["inst"][m], sub["ballot"][m], sub["op"][m]
-            lc, src = sub["last_committed"][m], sub["src"][m]
-            order = np.argsort(inst, kind="stable")
-            inst, ball, ok = inst[order], ball[order], ok[order]
-            lc, src = lc[order], src[order]
-            starts, ends = _runs(inst, ball, ok)
+            # rows arrive pre-compressed from the kernel (cmd_id = run
+            # length); map them 1:1 onto wire rows
             frame = make_batch(
-                kind, id=src[starts], ok=ok[starts], inst=inst[starts],
-                count=(ends - starts).astype(np.int32), ballot=ball[starts],
-                last_committed=lc[starts])
+                kind, id=sub["src"][m], ok=sub["op"][m],
+                inst=sub["inst"][m],
+                count=np.maximum(sub["cmd_id"][m], 1).astype(np.int32),
+                ballot=sub["ballot"][m],
+                last_committed=sub["last_committed"][m])
         elif kind == MsgKind.PREPARE:
             frame = make_batch(kind, leader_id=sub["src"][m],
                                ballot=sub["ballot"][m],

@@ -141,18 +141,47 @@ class Master:
                 leader = self.leader
             if not nodes:
                 continue
+            views: dict[int, int] = {}  # rid -> that replica's leader view
             for rid, (host, port) in nodes:
                 try:
                     resp = _rpc((host, port + CONTROL_OFFSET), {"m": "ping"},
                                 timeout=1.0)
                     ok = bool(resp.get("ok"))
                     fr = int(resp.get("frontier", -1))
+                    views[rid] = int(resp.get("leader", -1))
                 except (OSError, json.JSONDecodeError):
                     ok, fr = False, -1
                 with self._lock:
                     self.alive[rid] = ok
                     if ok:
                         self.frontiers[rid] = fr
+            # Adopt the leader a MAJORITY of replicas report when it
+            # differs from our belief: the protocol can move the
+            # leadership without us (a deposal election after a
+            # spurious promotion under load), and a stale GetLeader
+            # answer strands clients on a rejecting non-leader. The
+            # reference master has the same staleness (its GetLeader
+            # returns its own belief, master.go:154-163); here the
+            # pings already carry each replica's live view, so honesty
+            # is one majority vote away. Mencius replicas report -1
+            # (leaderless) and never trigger adoption.
+            with self._lock:
+                tally: dict[int, int] = {}
+                for rid, v in views.items():
+                    if self.alive[rid] and 0 <= v < len(self.nodes):
+                        tally[v] = tally.get(v, 0) + 1
+                if tally:
+                    top, cnt = max(tally.items(), key=lambda kv: kv[1])
+                    if (cnt >= self.n // 2 + 1 and top != self.leader
+                            and self.alive[top]):
+                        dlog(f"master: adopting protocol leader {top} "
+                             f"(was {self.leader})")
+                        self.leader = top
+                # the election branch below must see the adoption: its
+                # stale local would otherwise treat the DEAD old leader
+                # as current and fire a spurious be_the_leader that
+                # deposes the leader just adopted
+                leader = self.leader
             with self._lock:
                 leader_dead = (0 <= leader < len(self.alive)
                                and not self.alive[leader])

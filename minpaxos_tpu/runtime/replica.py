@@ -23,6 +23,7 @@ import functools
 import json
 import queue
 import socket
+import sys
 import threading
 import time
 from dataclasses import dataclass
@@ -55,6 +56,12 @@ from minpaxos_tpu.utils.netutil import CONTROL_OFFSET
 from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
 
 CONTROL = 3  # queue item source tag (transport uses 0..2)
+
+
+class FatalReplicaError(RuntimeError):
+    """The replica can no longer execute correctly and must fail-stop
+    (consensus tolerates a crashed replica; serving wrong data is the
+    one thing it cannot tolerate)."""
 
 
 @dataclass
@@ -133,6 +140,10 @@ class ReplicaServer:
         self._recovered = self.store.recovered
         self.stats = {"ticks": 0, "committed": 0, "executed": 0,
                       "proposals": 0}
+        # fail-stop reason: set when the replica can no longer execute
+        # correctly (e.g. KV table saturation — see _device_tick); the
+        # control plane reports it so operators/tests see the cause
+        self.fatal: str | None = None
         self._ctl_sock: socket.socket | None = None
         self._proto_thread: threading.Thread | None = None
         self._idle = False  # last step produced no work (throttle ticks)
@@ -296,8 +307,10 @@ class ReplicaServer:
                 m = req.get("m")
                 if m == "ping":
                     snap = self.snapshot  # one read: dict swap is atomic
-                    resp = {"ok": True, "frontier": snap["frontier"],
-                            "leader": snap["leader"], "stats": self.stats}
+                    resp = {"ok": self.fatal is None,
+                            "frontier": snap["frontier"],
+                            "leader": snap["leader"], "stats": self.stats,
+                            "fatal": self.fatal}
                 elif m == "be_the_leader":
                     self.queue.put((CONTROL, 0, "be_the_leader", None))
                     resp = {"ok": True}
@@ -343,6 +356,10 @@ class ReplicaServer:
                 self.queue.put((CONTROL, 0, "be_the_leader", None))
             while not self._stop.is_set():
                 self._tick()
+        except FatalReplicaError as e:
+            # fail-stop: stop serving; the control plane keeps
+            # answering pings with ok=False + the fatal reason
+            print(f"FATAL: {e}", file=sys.stderr, flush=True)
         finally:
             if prof is not None:
                 prof.disable()
@@ -508,7 +525,8 @@ class ReplicaServer:
         if persist:
             # always maintained (in-memory mirror feeds beyond-window
             # catch-up); -durable additionally fsyncs before replies
-            self._persist(cols, n_rows, out_cols, dst)
+            self._persist(cols, n_rows, out_cols,
+                          np.asarray(outbox.acked))
         if dispatch:
             self._dispatch(out_cols, dst)
             self._reply(execr, out_cols, dst)
@@ -516,6 +534,20 @@ class ReplicaServer:
             self.transport.flush_all()
         self._idle = (n_rows == 0 and not (out_cols["kind"] != 0).any()
                       and int(np.asarray(execr.count)) == 0)
+        # KV saturation is a correctness failure, not a statistic: a
+        # dropped insert belongs to a command that was (or will be)
+        # acked, so the state machine silently diverges from the log.
+        # The reference's Go map grows without limit (state.go:33-36);
+        # a fixed-capacity table must fail-stop instead of serving
+        # wrong data. Checked every tick (one scalar read alongside
+        # the snapshot reads below).
+        dropped = int(np.asarray(self.state.kv.dropped))
+        if dropped and self.fatal is None:
+            self.fatal = (
+                f"replica {self.me}: KV table saturated — {dropped} "
+                f"write(s) dropped (kv_pow2={self.cfg.kv_pow2} is too "
+                f"small for the live key space); failing stop")
+            raise FatalReplicaError(self.fatal)
         mencius = self.protocol == "mencius"
         self.snapshot = {
             "frontier": int(np.asarray(self.state.committed_upto)),
@@ -530,21 +562,23 @@ class ReplicaServer:
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
 
-    def _persist(self, in_cols, n_rows, out_cols, dst) -> None:
-        """Outbox row i is derived from inbox row i (models/minpaxos.py
-        Outbox doc), so accepted slots are recoverable host-side:
+    def _persist(self, in_cols, n_rows, out_cols, acked) -> None:
+        """Accepted slots are reconstructed host-side from the inbox
+        plus the kernel's outputs:
 
-        * follower acks: out ACCEPT_REPLY ok=1 at i -> slot from inbox i
+        * follower acks: the kernel's per-inbox-row ``acked`` mask
+          (Outbox.acked — outbox ACCEPT_REPLY rows are run-length
+          compressed and no longer align 1:1 with inbox rows) -> slot
+          from inbox ACCEPT row i
         * leader self-accepts: out ACCEPT broadcast at i -> cmd from
-          inbox PROPOSE row i
+          inbox PROPOSE row i (command rows stay row-aligned)
         * commits applied: inbox COMMIT rows
         * retry/noop rows (appended tail segments): out ACCEPT rows
           beyond the inbox range carry full commands
         """
         n = n_rows
         ik = in_cols["kind"][:n]
-        ok_acc = ((out_cols["kind"][:n] == int(MsgKind.ACCEPT_REPLY))
-                  & (out_cols["op"][:n] == 1) & (ik == int(MsgKind.ACCEPT)))
+        ok_acc = acked[:n] & (ik == int(MsgKind.ACCEPT))
         lead_acc = out_cols["kind"][:n] == int(MsgKind.ACCEPT)
         com = ik == int(MsgKind.COMMIT)
         recs = []

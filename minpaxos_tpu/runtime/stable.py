@@ -101,6 +101,13 @@ class StableStore:
     def _update_mirror(self, rec: np.ndarray) -> None:
         """Apply one record batch to the mirror (ballot supersede)."""
         insts = rec["inst"].astype(np.int64)
+        if int(insts.min()) < 0:
+            # the mirror indexes by inst directly: a negative inst (a
+            # padding row slipping through a caller's mask) would
+            # wrap-index and silently overwrite the highest slots
+            raise ValueError(
+                f"stable store: negative inst in record batch "
+                f"(min={int(insts.min())}) — caller mask bug")
         self._ensure(int(insts.max()))
         if len(np.unique(insts)) != len(insts):
             # same slot twice in one batch (e.g. ACCEPT + COMMIT in one

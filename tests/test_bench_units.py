@@ -107,6 +107,20 @@ def test_keybuf_amortized_append_and_view():
     assert kb2.view().tolist() == [(7 << 32) | 9]
 
 
+def test_keybuf_contains_matches_isin():
+    from minpaxos_tpu.models.cluster import KeyBuf, pack_reply_key
+
+    kb = KeyBuf()
+    assert not kb.contains(np.asarray([1, 2, 3])).any()  # empty buffer
+    rng = np.random.default_rng(7)
+    for i in range(5):  # interleave appends and probes (cache refresh)
+        kb.append(pack_reply_key(i, rng.integers(0, 1000, size=50)))
+        probe = pack_reply_key(rng.integers(0, 6, size=200),
+                               rng.integers(0, 1200, size=200))
+        assert np.array_equal(kb.contains(probe),
+                              np.isin(probe, kb.view()))
+
+
 def test_pack_reply_key_no_collisions_across_clients():
     from minpaxos_tpu.models.cluster import pack_reply_key
 

@@ -12,7 +12,7 @@ import pytest
 
 from minpaxos_tpu.models.cluster import Cluster, tree_slice
 from minpaxos_tpu.models.minpaxos import COMMITTED, MinPaxosConfig
-from minpaxos_tpu.wire.messages import Op
+from minpaxos_tpu.wire.messages import MsgKind, Op
 
 CFG = MinPaxosConfig(n_replicas=3, window=256, inbox=512, exec_batch=128,
                      kv_pow2=10)
@@ -48,6 +48,53 @@ def test_basic_put_get_commit():
         assert int(np.asarray(st.committed_upto)) == 2
 
 
+def test_follower_acks_are_run_length_compressed():
+    """A follower receiving p contiguous ACCEPTs must emit ONE live
+    ACCEPT_REPLY row covering the run (cmd_id = run length), not p rows
+    — the round-3 ack-row explosion fix. The per-inbox-row ``acked``
+    mask still reports every accepted row for the durability path."""
+    import jax.numpy as jnp
+
+    from minpaxos_tpu.models.minpaxos import (
+        MsgBatch,
+        init_replica,
+        replica_step_impl,
+    )
+
+    cfg = CFG
+    st = init_replica(cfg, me=1)
+    # adopt leader 0's ballot via a PREPARE first
+    prep = MsgBatch.empty(64)._replace(
+        kind=jnp.zeros(64, jnp.int32).at[0].set(int(MsgKind.PREPARE)),
+        ballot=jnp.zeros(64, jnp.int32).at[0].set(16),
+        last_committed=jnp.full(64, -1, jnp.int32))
+    st, _, _ = replica_step_impl(cfg, st, prep)
+    p = 40
+    rows = jnp.arange(64)
+    acc = MsgBatch.empty(64)._replace(
+        kind=jnp.where(rows < p, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
+        src=jnp.zeros(64, jnp.int32),
+        ballot=jnp.full(64, 16, jnp.int32),
+        inst=rows.astype(jnp.int32),
+        last_committed=jnp.full(64, -1, jnp.int32),
+        op=jnp.full(64, int(Op.PUT), jnp.int32),
+        key_lo=rows.astype(jnp.int32),
+        val_lo=rows.astype(jnp.int32))
+    st, outbox, _ = replica_step_impl(cfg, st, acc)
+    kinds = np.asarray(outbox.msgs.kind)
+    ar = kinds == int(MsgKind.ACCEPT_REPLY)
+    # exactly one live compressed ack for the whole contiguous run
+    # (plus possibly the appended frontier-gossip row, which carries
+    # op=0 and lives outside the first-64 inbox-aligned segment)
+    assert ar[:64].sum() == 1
+    i = int(np.nonzero(ar[:64])[0][0])
+    assert int(np.asarray(outbox.msgs.inst)[i]) == 0
+    assert int(np.asarray(outbox.msgs.cmd_id)[i]) == p
+    assert int(np.asarray(outbox.msgs.op)[i]) == 1
+    np.testing.assert_array_equal(np.asarray(outbox.acked)[:p], True)
+    np.testing.assert_array_equal(np.asarray(outbox.acked)[p:], False)
+
+
 def test_exactly_once_large_batch():
     c = boot()
     n = 200
@@ -80,7 +127,7 @@ def test_agreement_across_replicas():
                      np.asarray(st.val_lo), np.asarray(st.cmd_id)))
         live = np.asarray(st.kv.slot) == 1
         kvs.append(dict(zip(np.asarray(st.kv.key_lo)[live].tolist(),
-                            np.asarray(st.kv.val_lo)[live].tolist())))
+                            np.asarray(st.kv.val[:, 1])[live].tolist())))
     assert min(frontiers) == max(frontiers) >= 149
     # committed slots still resident in every window agree slot-by-slot
     # (Consistency; every replica retains `retention` executed slots,

@@ -43,27 +43,33 @@ def test_propose_frame_to_rows_splits_i64():
 
 
 def test_accept_reply_run_length_roundtrip():
-    """Per-slot acks -> (inst, count) runs on the wire -> per-slot rows."""
+    """Kernel-native (inst, count) ack runs ride the wire 1:1: device
+    cmd_id <-> wire count, no re-expansion on receive (the kernel
+    consumes ranges natively — models/minpaxos.py step 6)."""
     cols = {c: np.zeros(10, np.int32) for c in batches.COLS}
-    # two runs: slots 5..8 ok at ballot 3 from replica 1; slot 20 nack
-    cols["kind"][:5] = int(MsgKind.ACCEPT_REPLY)
-    cols["inst"][:5] = [5, 6, 7, 8, 20]
-    cols["ballot"][:5] = [3, 3, 3, 3, 7]
-    cols["op"][:5] = [1, 1, 1, 1, 0]
-    cols["src"][:5] = 1
-    cols["last_committed"][:5] = 4
+    # two runs from the kernel: slots 5..8 ok at ballot 3 (count=4 on
+    # the start row), slot 20 nack (count=1)
+    cols["kind"][:2] = int(MsgKind.ACCEPT_REPLY)
+    cols["inst"][:2] = [5, 20]
+    cols["cmd_id"][:2] = [4, 1]
+    cols["ballot"][:2] = [3, 7]
+    cols["op"][:2] = [1, 0]
+    cols["src"][:2] = 1
+    cols["last_committed"][:2] = 4
     frames = batches.rows_to_frames(cols, cols["kind"] != 0)
     assert len(frames) == 1
     kind, frame = frames[0]
     assert kind == MsgKind.ACCEPT_REPLY
-    assert len(frame) == 2  # compressed to 2 runs
+    assert len(frame) == 2  # one wire row per run
     np.testing.assert_array_equal(sorted(frame["count"]), [1, 4])
-    # expand back
+    # receive side: count lands back in cmd_id, still 2 rows
     buf = batches.ColumnBuffer(16)
     batches.frame_to_rows(buf, MsgKind.ACCEPT_REPLY, frame, conn_id=0)
     out, n = buf.drain()
-    assert n == 5
-    np.testing.assert_array_equal(np.sort(out["inst"][:5]), [5, 6, 7, 8, 20])
+    assert n == 2
+    np.testing.assert_array_equal(np.sort(out["inst"][:2]), [5, 20])
+    np.testing.assert_array_equal(np.sort(out["cmd_id"][:2]), [1, 4])
+    np.testing.assert_array_equal(np.sort(out["op"][:2]), [0, 1])
 
 
 def test_accept_frame_roundtrip():

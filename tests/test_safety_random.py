@@ -135,7 +135,7 @@ def test_revived_replica_full_value_agreement():
     assert int(np.asarray(st2.executed_upto)) == n - 1
     live = np.asarray(st2.kv.slot) == 1
     got = dict(zip(np.asarray(st2.kv.key_lo)[live].tolist(),
-                   np.asarray(st2.kv.val_lo)[live].tolist()))
+                   np.asarray(st2.kv.val[:, 1])[live].tolist()))
     assert got == {int(k): int(k) * 7 for k in range(n)}
 
 
@@ -202,5 +202,5 @@ def test_laggard_healed_by_new_leader_after_failover():
     assert int(np.asarray(st2.executed_upto)) >= n - 1
     live = np.asarray(st2.kv.slot) == 1
     got = dict(zip(np.asarray(st2.kv.key_lo)[live].tolist(),
-                   np.asarray(st2.kv.val_lo)[live].tolist()))
+                   np.asarray(st2.kv.val[:, 1])[live].tolist()))
     assert got == {int(k): int(k) * 5 for k in range(n)}
