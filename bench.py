@@ -65,103 +65,14 @@ def _failure(stage: str, err: str, **extra) -> None:
     })
 
 
-def _probe_backend(timeout_s: float = 120.0) -> str | None:
-    """Subprocess probe: the default backend's platform name, or None
-    if init fails/hangs. Popen + DEVNULL + process-group kill, NOT
-    subprocess.run with capture_output: a hung backend init can leave
-    grandchildren (tunnel helpers) holding the output pipes, and
-    run()'s post-kill communicate() then blocks forever. A probe
-    subprocess can't poison this process's backend lock."""
-    import os
-    import signal
-    import subprocess
-    import tempfile
-
-    with tempfile.NamedTemporaryFile("r", suffix=".probe") as tf:
-        p = subprocess.Popen(
-            [sys.executable, "-c",
-             "import jax, pathlib; pathlib.Path("
-             f"{tf.name!r}).write_text(jax.devices()[0].platform)"],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True)
-        try:
-            rc = p.wait(timeout=timeout_s)
-            platform = tf.read().strip()
-            return platform if rc == 0 and platform else None
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
-            except (OSError, ProcessLookupError):
-                pass
-            return None
-
-
-def _devices_main_thread(jax):
-    """In-process jax.devices() on the MAIN thread, no watchdog.
-
-    Round-4 finding: the axon backend HANGS when initialized from a
-    non-main thread (a bare main-thread ``jax.devices()`` succeeds in
-    ~2s while the same call in a watchdog thread blocks forever) — so
-    the round-3 watchdog design *caused* the init failures it was
-    guarding against, and each aborted attempt wedged the relay for
-    minutes. Hang protection belongs to the PARENT: measure() always
-    runs as a child of main()'s ladder (subprocess timeout + kill), so
-    a blocking init here is safe and honest."""
-    return jax.devices()
-
-
-def _init_backend(retries: int = 2, timeout_s: float = 120.0):
-    """Initialize a JAX backend defensively. The tunnel's TPU backend
-    can hang on init *holding the global backend lock* — once that
-    happens in-process, even jax.devices("cpu") blocks forever. So the
-    default backend is probed in a SUBPROCESS with a timeout first; the
-    in-process backend is only initialized down a path the probe proved
-    alive, else the CPU platform is pinned before any backend touch."""
-    import os
-
-    import jax
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        # explicit operator choice; sitecustomize may have pinned the
-        # config elsewhere, so re-assert it (this is what lets
-        # `JAX_PLATFORMS=cpu python bench.py` work under the tunnel)
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
-        if want.startswith("cpu"):
-            return jax.devices()
-        # explicit non-cpu platform (the tunnel env exports
-        # JAX_PLATFORMS=axon): main-thread init; the ladder driver's
-        # child timeout handles a genuine hang
-        return _devices_main_thread(jax)
-
-    if os.environ.get("MP_BENCH_PROBED"):
-        # the ladder driver probed this backend seconds ago; skip the
-        # redundant subprocess init (expensive over the tunnel)
-        return _devices_main_thread(jax)
-
-    ok = False
-    for attempt in range(retries):
-        platform = _probe_backend(timeout_s)
-        if platform:
-            _progress(f"probe: default backend alive ({platform})")
-            ok = True
-            break
-        _progress(f"probe attempt {attempt}: dead/hung")
-        time.sleep(2.0)
-
-    if not ok:
-        _progress("default backend unavailable; pinning cpu")
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception as e:
-            _failure("backend-init", repr(e))
-            sys.exit(0)
-        return jax.devices()
-
-    return _devices_main_thread(jax)
+# Backend probing/init lives in the shared playbook module so the
+# multichip dryrun and future tools reuse the exact same defenses
+# (subprocess probe, main-thread-only init, parent-owned timeouts).
+from minpaxos_tpu.utils.backend import (  # noqa: E402
+    init_backend as _init_backend,
+    probe_backend as _probe_backend,
+    wait_for_backend as _wait_for_backend,
+)
 
 
 def _latency_rounds(uptos, crts, round_ms):
@@ -266,7 +177,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
     process crashed or restarted' during the 1M-instance warmup), and
     a crashed worker poisons the in-process backend — only a fresh
     process can retry."""
-    devices = _init_backend()
+    devices = _init_backend(progress=_progress, on_fail=_failure)
     import jax
     import numpy as np
 
@@ -303,10 +214,18 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
     # kv_pow2 15 = 32k entries vs the 16k-key workload key_space: 2x
     # headroom at half the HBM of the former 2^16 tables (the KV is the
     # dominant allocation — ~0.9 GB saved at g=256)
+    # inbox sizing (round 4): acks are run-length compressed in the
+    # kernel, so a follower's inbox holds p ACCEPT rows plus the
+    # catch-up/retry/sweep appendices (2*catchup + recovery + gossip),
+    # and the leader's holds ~R compressed ack rows — the old 4p+256
+    # sizing paid for (R-1)*p per-slot ack rows that no longer exist.
+    # Every [M]-shaped step computation and routed array shrinks with
+    # it (measured 30% faster fused rounds on the CPU mesh).
+    cu_rows = 512 if on_tpu else 128
     cfg = MinPaxosConfig(
-        n_replicas=5, window=w, inbox=4 * p + 256, exec_batch=p,
-        kv_pow2=15 if on_tpu else 10,
-        catchup_rows=512 if on_tpu else 128, recovery_rows=64)
+        n_replicas=5, window=w, inbox=p + 2 * cu_rows + 64 + 64,
+        exec_batch=p, kv_pow2=15 if on_tpu else 10,
+        catchup_rows=cu_rows, recovery_rows=64)
     t_boot = time.perf_counter()
     try:
         # key_space < KV capacity: the run inserts ~dispatches*k*p
@@ -464,10 +383,11 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
                                catchup_rows=32, recovery_rows=32),
                 1, 1, 128 if on_tpu else 32, "classic"),
             # cfg3: classic paxos, 16 clients (=16 shards), 64k
-            # concurrent instances
+            # concurrent instances (inbox: p + appendices — acks are
+            # run-length compressed)
             "paxos_64k": (
                 classic_config(n_replicas=5, window=4096,
-                               inbox=4 * 256 + 128, exec_batch=256,
+                               inbox=256 + 2 * 64 + 128, exec_batch=256,
                                kv_pow2=14, catchup_rows=64,
                                recovery_rows=64),
                 16, 256, 32 if on_tpu else 8, "classic"),
@@ -564,15 +484,7 @@ def main() -> None:
         # doesn't). Worst case this gate costs ~12 min (5 probes that
         # each hang their 120s timeout, plus inter-probe sleeps only
         # after fast failures) vs a child's 40-min timeout.
-        for attempt in range(5):
-            t_probe = time.monotonic()
-            alive = _probe_backend()
-            if alive and alive != "cpu":
-                break
-            _progress(f"backend probe dead ({attempt})")
-            if attempt < 4 and time.monotonic() - t_probe < 110:
-                time.sleep(120)  # fast failure: wait out the respawn
-        else:
+        if _wait_for_backend(progress=_progress) is None:
             last_fail = "backend unreachable after 5 probes"
             _progress(last_fail)
             break

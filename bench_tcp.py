@@ -38,9 +38,14 @@ def _progress(msg: str) -> None:
 
 
 def run_config(proto_flag: str, label: str, ref_shape: str,
-               q: int) -> dict:
+               q: int, multi_rr: bool = False) -> dict:
     """Boot a fresh 3-replica cluster with ``proto_flag``, measure
-    closed-loop throughput (-check) + 200 serial ops, tear down."""
+    closed-loop throughput (-check) + 200 serial ops, tear down.
+
+    ``multi_rr``: drive the throughput leg with the leaderless
+    round-robin MultiClient (reference client.go -e) — the Mencius
+    deployment's intended workload: all owners serve concurrently
+    instead of one hinted proposer making every other owner cede."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
     # control ports are data+1000 (reference scheme); pick data ports
     # whose +1000 sibling is verified free too
@@ -117,9 +122,18 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
 
         # throughput leg: q closed-loop batched requests, -check
         ops, keys, vals = gen_workload(q, seed=42)
-        t0 = time.perf_counter()
-        stats = cli.run_workload(ops, keys, vals, timeout_s=120)
-        wall = time.perf_counter() - t0
+        if multi_rr:
+            from minpaxos_tpu.runtime.client import MultiClient
+
+            mc = MultiClient(("127.0.0.1", mport), check=True, mode="rr")
+            t0 = time.perf_counter()
+            stats = mc.run_workload(ops, keys, vals, timeout_s=120)
+            wall = time.perf_counter() - t0
+            mc.close()
+        else:
+            t0 = time.perf_counter()
+            stats = cli.run_workload(ops, keys, vals, timeout_s=120)
+            wall = time.perf_counter() - t0
         ok = (stats["acked"] == q and stats["duplicates"] == 0)
 
         # latency leg: 200 serial one-at-a-time ops with UNIQUE cmd_ids
@@ -138,6 +152,7 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
         lats.sort()
         rec = {
             "config": label,
+            "client_mode": "rr_all_owners" if multi_rr else "single_conn",
             "ops_per_sec": round(q / wall, 1),
             "acked": stats["acked"],
             "check": "ok" if ok else f"FAILED {stats}",
@@ -184,7 +199,8 @@ def main() -> None:
         rec["mencius_tcp"] = run_config(
             "-m", "mencius_tcp_3rep_durable (beyond reference: its "
             "server never shipped mencius)",
-            "mencius.go:83-897 over the bareminrun.sh topology", q)
+            "mencius.go:83-897 over the bareminrun.sh topology", q,
+            multi_rr=True)
     except Exception as e:  # noqa: BLE001 — config 1 is the headline
         rec["mencius_tcp"] = {"error": repr(e)[:200]}
     out_path.write_text(json.dumps(rec) + "\n")

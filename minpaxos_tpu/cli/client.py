@@ -94,11 +94,12 @@ def main(argv=None) -> None:
     p.add_argument("-q", type=int, default=1000, help="requests per round")
     p.add_argument("-r", type=int, default=1, help="rounds")
     p.add_argument("-c", type=int, default=0, help="conflict percent")
-    p.add_argument("-sr", type=int, default=100000,
+    p.add_argument("-sr", type=int, default=30000,
                    help="key range (reference clientlat -sr). Size it "
-                        "below the servers' KV capacity (kv_pow2): the "
-                        "runtime fail-stops on table saturation rather "
-                        "than silently dropping acknowledged writes")
+                        "below the servers' KV capacity (-kvpow2, "
+                        "default 2^16): the runtime fail-stops on table "
+                        "saturation rather than silently dropping "
+                        "acknowledged writes")
     p.add_argument("-z", type=float, default=0.0, help="Zipfian s (0=uniform)")
     p.add_argument("-w", type=int, default=100, help="write percent")
     p.add_argument("-check", action="store_true",
@@ -112,11 +113,29 @@ def main(argv=None) -> None:
                    help="open-loop: paced submission, reply-ts latency")
     p.add_argument("-ns", type=int, default=1_000_000,
                    help="open-loop pacing: ns between batches")
+    p.add_argument("-e", dest="rr", action="store_true",
+                   help="leaderless round-robin sends across all "
+                        "replicas (reference client.go -e; the natural "
+                        "Mencius driver)")
+    p.add_argument("-f", dest="fast", action="store_true",
+                   help="fast mode: send to ALL replicas, first reply "
+                        "wins (reference client.go -f; paxos family "
+                        "only)")
     p.add_argument("-timeout", type=float, default=60.0)
     args = p.parse_args(argv)
 
-    from minpaxos_tpu.runtime.client import Client, gen_workload
+    from minpaxos_tpu.runtime.client import (
+        Client,
+        MultiClient,
+        gen_workload,
+    )
 
+    multi = None
+    if args.rr or args.fast:
+        if args.lat or args.ol:
+            p.error("-e/-f apply to the closed-loop mode only")
+        multi = MultiClient((args.maddr, args.mport), check=args.check,
+                            mode="rr" if args.rr else "fast")
     cli = Client((args.maddr, args.mport), check=args.check)
 
     total_acked = 0
@@ -220,8 +239,9 @@ def main(argv=None) -> None:
                     daemon=True)
                 sampler.start()
             t0 = time.monotonic()
-            stats = cli.run_workload(ops, keys, vals, batch=args.batch,
-                                     timeout_s=args.timeout)
+            driver = multi if multi is not None else cli
+            stats = driver.run_workload(ops, keys, vals, batch=args.batch,
+                                        timeout_s=args.timeout)
             wall = time.monotonic() - t0
             if args.tot:
                 stop.set()
@@ -244,9 +264,15 @@ def main(argv=None) -> None:
         # fresh cmd_id space per round
         cli.replies.clear()
         cli.rejected.clear()
+        if multi is not None:
+            for c in multi.clients:
+                c.replies.clear()
+                c.rejected.clear()
     wall_all = time.monotonic() - t_all
     print(f"total: {total_acked} acked in {wall_all:.3f}s "
           f"({total_acked / wall_all:.0f} ops/s)", flush=True)
+    if multi is not None:
+        multi.close()
     cli.close_conn()
 
 

@@ -46,6 +46,11 @@ def main(argv=None) -> None:
                    help="send accepts to a bare quorum only")
     p.add_argument("-beacon", action="store_true",
                    help="RTT beacons; thrifty prefers fastest peers")
+    p.add_argument("-kvpow2", type=int, default=16,
+                   help="KV table capacity = 2^kvpow2 slots; size above "
+                        "the workload's distinct-key count (saturation "
+                        "fail-stops the replica), but not higher than "
+                        "needed — per-tick KV cost scales with capacity")
     p.add_argument("-window", type=int, default=1 << 14,
                    help="resident log window slots")
     p.add_argument("-inbox", type=int, default=4096,
@@ -80,14 +85,18 @@ def main(argv=None) -> None:
 
     protocol = ("mencius" if args.mencius
                 else "classic" if args.classic else "minpaxos")
-    # kv_pow2=20 (1M slots, ~25 MB): comfortably above the client's
-    # default -sr key range (100k) — the runtime FAIL-STOPS on table
-    # saturation rather than silently dropping acknowledged writes, so
-    # the default server capacity must dominate the default client key
-    # space (the reference's Go map just grows, state.go:33-36)
+    # kv_pow2 default 16 (65536 slots) comfortably dominates the
+    # client's default -sr key range (30000) — the runtime FAIL-STOPS
+    # on table saturation rather than silently dropping acknowledged
+    # writes (the reference's Go map just grows, state.go:33-36), so
+    # capacity and key space must be sized together. NOTE the
+    # per-tick KV cost scales with table CAPACITY (the parallel claim
+    # loop materializes a capacity-length array per probe iteration,
+    # ops/kvstore.py), so "just make it huge" measurably slows every
+    # tick — raise -kvpow2 deliberately, with the workload in mind.
     cfg = MinPaxosConfig(
         n_replicas=len(nodes), window=args.window, inbox=args.inbox,
-        exec_batch=args.inbox, kv_pow2=20,
+        exec_batch=args.inbox, kv_pow2=args.kvpow2,
         catchup_rows=256, recovery_rows=256,
         explicit_commit=args.classic and not args.mencius)
     prof = cProfile.Profile() if args.cpuprofile else None

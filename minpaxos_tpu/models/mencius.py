@@ -63,7 +63,12 @@ from minpaxos_tpu.models.minpaxos import (
     _rel,
     make_ballot,
 )
-from minpaxos_tpu.ops.ackruns import compress_ack_runs, range_vote_coverage
+from minpaxos_tpu.ops.ackruns import (
+    compress_ack_runs,
+    pack_vote_bits,
+    range_vote_coverage,
+    scatter_vote_bits,
+)
 from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
 from minpaxos_tpu.ops.scan import commit_frontier, segmented_scan_max
 from minpaxos_tpu.wire.messages import MsgKind, Op
@@ -74,18 +79,20 @@ class MenciusState(NamedTuple):
     ReplicaState where the host wrappers read them (committed_upto,
     executed_upto, crt_inst, window_base, kv...)."""
 
-    # log window [S]
+    # log window [S]; status/op u8 and votes/pvotes packed u16, as in
+    # ReplicaState (the window arrays are the step's dominant HBM
+    # traffic)
     ballot: jnp.ndarray  # i32: 0 = owner ballot, >0 takeover
-    status: jnp.ndarray
-    op: jnp.ndarray
+    status: jnp.ndarray  # u8
+    op: jnp.ndarray  # u8
     key_hi: jnp.ndarray
     key_lo: jnp.ndarray
     val_hi: jnp.ndarray
     val_lo: jnp.ndarray
     cmd_id: jnp.ndarray
     client_id: jnp.ndarray
-    votes: jnp.ndarray  # bool[S, R] acks for my owned slots
-    pvotes: jnp.ndarray  # bool[S, R] takeover phase-1 answers
+    votes: jnp.ndarray  # u16[S] acks for my owned slots
+    pvotes: jnp.ndarray  # u16[S] takeover phase-1 answers
     executed: jnp.ndarray  # bool[S] (out-of-order exec tracking)
     # scalars
     me: jnp.ndarray
@@ -111,16 +118,16 @@ def init_mencius(cfg: MinPaxosConfig, me: int) -> MenciusState:
 
     return MenciusState(
         ballot=jnp.full(s, NO_BALLOT, dtype=jnp.int32),
-        status=zi(),
-        op=zi(),
+        status=jnp.zeros(s, dtype=jnp.uint8),
+        op=jnp.zeros(s, dtype=jnp.uint8),
         key_hi=zi(),
         key_lo=zi(),
         val_hi=zi(),
         val_lo=zi(),
         cmd_id=zi(),
         client_id=zi(),
-        votes=jnp.zeros((s, r), dtype=bool),
-        pvotes=jnp.zeros((s, r), dtype=bool),
+        votes=jnp.zeros(s, dtype=jnp.uint16),
+        pvotes=jnp.zeros(s, dtype=jnp.uint16),
         executed=jnp.zeros(s, dtype=bool),
         me=jnp.int32(me),
         window_base=jnp.int32(0),
@@ -169,19 +176,18 @@ def mencius_step_impl(
     rel_p = slots_p - state.window_base
     fits = is_propose & (rel_p >= 0) & (rel_p < S)
     tgt_p = jnp.where(fits, rel_p, S)
-    self_vote = jax.nn.one_hot(me, R, dtype=bool)
+    me_bit = (jnp.int32(1) << me).astype(jnp.uint16)
     state = state._replace(
         ballot=state.ballot.at[tgt_p].set(0, mode="drop"),
-        status=state.status.at[tgt_p].set(ACCEPTED, mode="drop"),
-        op=state.op.at[tgt_p].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt_p].set(jnp.uint8(ACCEPTED), mode="drop"),
+        op=state.op.at[tgt_p].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt_p].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt_p].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt_p].set(inbox.val_hi, mode="drop"),
         val_lo=state.val_lo.at[tgt_p].set(inbox.val_lo, mode="drop"),
         cmd_id=state.cmd_id.at[tgt_p].set(inbox.cmd_id, mode="drop"),
         client_id=state.client_id.at[tgt_p].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_p].set(
-            jnp.broadcast_to(self_vote, (M, R)), mode="drop"),
+        votes=state.votes.at[tgt_p].set(me_bit, mode="drop"),
     )
     n_prop = jnp.where(fits, 1, 0).sum()
     state = state._replace(
@@ -227,8 +233,8 @@ def mencius_step_impl(
     tgt_a = jnp.where(acc_ok, rel_a, S)
     state = state._replace(
         ballot=state.ballot.at[tgt_a].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_a].set(ACCEPTED, mode="drop"),
-        op=state.op.at[tgt_a].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt_a].set(jnp.uint8(ACCEPTED), mode="drop"),
+        op=state.op.at[tgt_a].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt_a].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt_a].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt_a].set(inbox.val_hi, mode="drop"),
@@ -364,7 +370,8 @@ def mencius_step_impl(
     drv_slot = own_mask | (
         (state.ballot > 0) & (jnp.mod(state.ballot, 16) == me))
     state = state._replace(
-        votes=state.votes | (vote_cov & drv_slot[:, None]))
+        votes=state.votes | pack_vote_bits(
+            vote_cov & drv_slot[:, None]))
 
     # ---- 6. COMMIT rows (explicit commit transfer, bcastCommit) ----
     rel_c, in_win_c = _rel(state, inbox.inst, S)
@@ -372,8 +379,8 @@ def mencius_step_impl(
     tgt_c = jnp.where(com_ok, rel_c, S)
     state = state._replace(
         ballot=state.ballot.at[tgt_c].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_c].max(COMMITTED, mode="drop"),
-        op=state.op.at[tgt_c].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt_c].max(jnp.uint8(COMMITTED), mode="drop"),
+        op=state.op.at[tgt_c].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt_c].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt_c].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt_c].set(inbox.val_hi, mode="drop"),
@@ -437,9 +444,8 @@ def mencius_step_impl(
     pv_ok = (is_pir & (inbox.last_committed == state.takeover_ballot)
              & in_win_v)
     state = state._replace(
-        pvotes=state.pvotes.at[
-            jnp.where(pv_ok, rel_v, S), jnp.clip(inbox.src, 0, R - 1)
-        ].set(True, mode="drop"))
+        pvotes=state.pvotes | scatter_vote_bits(S, rel_v, inbox.src,
+                                                pv_ok, R))
     pir_ok = (pv_ok & (state.status[rel_v_safe] < COMMITTED)
               & (inbox.ballot > NO_BALLOT)
               & (inbox.ballot > state.ballot[rel_v_safe]))
@@ -449,20 +455,19 @@ def mencius_step_impl(
     tgt_v = jnp.where(pir_win, rel_v, S)
     state = state._replace(
         ballot=state.ballot.at[tgt_v].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_v].set(ACCEPTED, mode="drop"),
-        op=state.op.at[tgt_v].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt_v].set(jnp.uint8(ACCEPTED), mode="drop"),
+        op=state.op.at[tgt_v].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt_v].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt_v].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt_v].set(inbox.val_hi, mode="drop"),
         val_lo=state.val_lo.at[tgt_v].set(inbox.val_lo, mode="drop"),
         cmd_id=state.cmd_id.at[tgt_v].set(inbox.cmd_id, mode="drop"),
         client_id=state.client_id.at[tgt_v].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_v].set(
-            jnp.broadcast_to(self_vote, (M, R)), mode="drop"),
+        votes=state.votes.at[tgt_v].set(me_bit, mode="drop"),
     )
 
     # ---- 8. commit scan: my owned slots at majority, frontier ----
-    n_votes = state.votes.sum(axis=1)
+    n_votes = jax.lax.population_count(state.votes).astype(jnp.int32)
     driven_by_me = own_mask | (
         (state.ballot > 0) & (jnp.mod(state.ballot, 16) == me))
     my_commit = (driven_by_me & (state.status == ACCEPTED)
@@ -509,7 +514,7 @@ def mencius_step_impl(
         ballot=state.ballot[cb_rel_safe],
         inst=cb_slots,
         last_committed=jnp.full(K, state.committed_upto, jnp.int32),
-        op=state.op[cb_rel_safe],
+        op=state.op[cb_rel_safe].astype(jnp.int32),
         key_hi=state.key_hi[cb_rel_safe],
         key_lo=state.key_lo[cb_rel_safe],
         val_hi=state.val_hi[cb_rel_safe],
@@ -545,7 +550,7 @@ def mencius_step_impl(
         ballot=state.ballot[ta_rel_safe],
         inst=ta_slots,
         last_committed=jnp.full(K2b, state.committed_upto, jnp.int32),
-        op=state.op[ta_rel_safe],
+        op=state.op[ta_rel_safe].astype(jnp.int32),
         key_hi=state.key_hi[ta_rel_safe],
         key_lo=state.key_lo[ta_rel_safe],
         val_hi=state.val_hi[ta_rel_safe],
@@ -577,7 +582,7 @@ def mencius_step_impl(
     state = state._replace(
         takeover_ballot=tb,
         max_recv_ballot=jnp.maximum(state.max_recv_ballot, tb),
-        pvotes=jnp.where(fresh, jnp.zeros((S, R), bool), state.pvotes),
+        pvotes=jnp.where(fresh, jnp.uint16(0), state.pvotes),
         tk_anchor=jnp.where(fresh, blocking, state.tk_anchor),
     )
     K2 = cfg.recovery_rows
@@ -593,11 +598,13 @@ def mencius_step_impl(
         inst=tk_slots,
     )
     state = state._replace(
-        pvotes=state.pvotes.at[
-            jnp.where(tk_ok, tk_rel, S), me].set(True, mode="drop"))
+        # constant me_bit under duplicate indices: plain .set is a
+        # safe scatter-OR through the zeros temp
+        pvotes=state.pvotes | jnp.zeros(S, jnp.uint16).at[
+            jnp.where(tk_ok, tk_rel, S)].set(me_bit, mode="drop"))
     # no-op fill empties with a phase-1 majority; re-drive adopted
     # values; both as ACCEPTs at the takeover ballot
-    pv_cnt = state.pvotes.sum(axis=1)
+    pv_cnt = jax.lax.population_count(state.pvotes).astype(jnp.int32)
     in_tk_span = (idx_abs >= blocking) & (
         idx_abs < blocking + K2) & (idx_abs < state.crt_inst)
     fill = (do_tk & in_tk_span & (state.status == NONE)
@@ -608,14 +615,14 @@ def mencius_step_impl(
         op=jnp.where(fill, int(Op.NONE), state.op),
         cmd_id=jnp.where(fill, 0, state.cmd_id),
         client_id=jnp.where(fill, -1, state.client_id),
-        votes=jnp.where(fill[:, None], self_vote[None, :], state.votes),
+        votes=jnp.where(fill, me_bit, state.votes),
     )
     redrive = (do_tk & in_tk_span & (state.status == ACCEPTED)
                & ((state.ballot == tb) | (pv_cnt >= majority)))
     bump = redrive & (state.ballot != tb)
     state = state._replace(
         ballot=jnp.where(bump, tb, state.ballot),
-        votes=jnp.where(bump[:, None], self_vote[None, :], state.votes),
+        votes=jnp.where(bump, me_bit, state.votes),
     )
     rd_slots = blocking + jnp.arange(K2, dtype=jnp.int32)
     rd_rel_safe = jnp.clip(rd_slots - state.window_base, 0, S - 1)
@@ -626,7 +633,7 @@ def mencius_step_impl(
         ballot=jnp.full(K2, tb, jnp.int32),
         inst=rd_slots,
         last_committed=jnp.full(K2, state.committed_upto, jnp.int32),
-        op=state.op[rd_rel_safe],
+        op=state.op[rd_rel_safe].astype(jnp.int32),
         key_hi=state.key_hi[rd_rel_safe],
         key_lo=state.key_lo[rd_rel_safe],
         val_hi=state.val_hi[rd_rel_safe],
@@ -716,9 +723,10 @@ def mencius_step_impl(
         jnp.where(take, exec_rank, E)].min(idx, mode="drop")
     evalid = slot_of < S
     slot_of_safe = jnp.clip(slot_of, 0, S - 1)
+    op_e = jnp.where(evalid, state.op[slot_of_safe].astype(jnp.int32), 0)
     kv, o_hi, o_lo, o_found = kv_apply_batch(
         state.kv,
-        jnp.where(evalid, state.op[slot_of_safe], 0),
+        op_e,
         state.key_hi[slot_of_safe],
         state.key_lo[slot_of_safe],
         state.val_hi[slot_of_safe],
@@ -741,7 +749,7 @@ def mencius_step_impl(
     execr = ExecResult(
         lo=exec_lo, count=evalid.sum(),
         val_hi=o_hi, val_lo=o_lo, found=o_found,
-        op=jnp.where(evalid, state.op[slot_of_safe], 0),
+        op=op_e,
         cmd_id=jnp.where(evalid, state.cmd_id[slot_of_safe], 0),
         client_id=jnp.where(evalid, state.client_id[slot_of_safe], 0),
     )
@@ -769,8 +777,8 @@ def mencius_step_impl(
             val_lo=slide(state.val_lo, 0),
             cmd_id=slide(state.cmd_id, 0),
             client_id=slide(state.client_id, 0),
-            votes=slide(state.votes, False),
-            pvotes=slide(state.pvotes, False),
+            votes=slide(state.votes, 0),
+            pvotes=slide(state.pvotes, 0),
             executed=slide(state.executed, False),
             window_base=state.window_base + shift,
         )

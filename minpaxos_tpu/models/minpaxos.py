@@ -37,7 +37,12 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from minpaxos_tpu.ops.ackruns import compress_ack_runs, range_vote_coverage
+from minpaxos_tpu.ops.ackruns import (
+    compress_ack_runs,
+    pack_vote_bits,
+    range_vote_coverage,
+    scatter_vote_bits,
+)
 from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
 from minpaxos_tpu.ops.scan import commit_frontier
 from minpaxos_tpu.wire.messages import MsgKind
@@ -158,17 +163,20 @@ class ExecResult(NamedTuple):
 class ReplicaState(NamedTuple):
     """Everything one replica owns, as device arrays."""
 
-    # log window [S]
+    # log window [S]. Width matters: these arrays are the dominant
+    # HBM traffic of a step (PERF.md), so status/op are u8 (values
+    # 0..5) and votes/pvotes are packed u16 bitmasks (R <= 16 by the
+    # ballot encoding) instead of i32 / bool[S, R].
     ballot: jnp.ndarray  # i32: accepted ballot per slot
-    status: jnp.ndarray  # i32
-    op: jnp.ndarray
+    status: jnp.ndarray  # u8
+    op: jnp.ndarray  # u8
     key_hi: jnp.ndarray
     key_lo: jnp.ndarray
     val_hi: jnp.ndarray
     val_lo: jnp.ndarray
     cmd_id: jnp.ndarray
     client_id: jnp.ndarray
-    votes: jnp.ndarray  # bool[S, R]
+    votes: jnp.ndarray  # u16[S]: bit r = replica r acked this slot
     # scalars
     me: jnp.ndarray  # i32
     window_base: jnp.ndarray  # i32 absolute slot of window index 0
@@ -191,7 +199,7 @@ class ReplicaState(NamedTuple):
     # slot may be no-op filled ONLY once a majority has answered "no
     # value" — the safety condition the reference approximates with its
     # full CatchUpLog shipping (bareminpaxos.go:488-513, :912-966)
-    pvotes: jnp.ndarray  # bool[S, R]
+    pvotes: jnp.ndarray  # u16[S]: bit r = replica r answered phase 1
     rec_cursor: jnp.ndarray  # i32 next slot the leader's sweep requests
     kv: KVState
 
@@ -210,15 +218,15 @@ def init_replica(cfg: MinPaxosConfig, me: int) -> ReplicaState:
 
     return ReplicaState(
         ballot=jnp.full(s, NO_BALLOT, dtype=jnp.int32),
-        status=zi(),
-        op=zi(),
+        status=jnp.zeros(s, dtype=jnp.uint8),
+        op=jnp.zeros(s, dtype=jnp.uint8),
         key_hi=zi(),
         key_lo=zi(),
         val_hi=zi(),
         val_lo=zi(),
         cmd_id=zi(),
         client_id=zi(),
-        votes=jnp.zeros((s, r), dtype=bool),
+        votes=jnp.zeros(s, dtype=jnp.uint16),
         me=jnp.int32(me),
         window_base=jnp.int32(0),
         crt_inst=jnp.int32(0),
@@ -232,7 +240,7 @@ def init_replica(cfg: MinPaxosConfig, me: int) -> ReplicaState:
         peer_commits=jnp.full(r, -1, dtype=jnp.int32),
         tick=jnp.int32(0),
         stall_ticks=jnp.int32(0),
-        pvotes=jnp.zeros((s, r), dtype=bool),
+        pvotes=jnp.zeros(s, dtype=jnp.uint16),
         rec_cursor=jnp.int32(0),
         kv=kv_init(cfg.kv_pow2),
     )
@@ -261,7 +269,7 @@ def become_leader(cfg: MinPaxosConfig, state: ReplicaState) -> tuple[ReplicaStat
         prepare_oks=jnp.zeros(cfg.n_replicas, dtype=bool).at[state.me].set(True),
         # fresh ballot -> stale phase-1 answers must not count; restart
         # the per-instance discovery sweep at our commit frontier
-        pvotes=jnp.zeros((cfg.window, cfg.n_replicas), dtype=bool),
+        pvotes=jnp.zeros(cfg.window, dtype=jnp.uint16),
         rec_cursor=state.committed_upto + 1,
     )
     out = MsgBatch.empty(1)
@@ -347,6 +355,10 @@ def replica_step_impl(
     # * pvotes — EVERY current-ballot answer (value or "empty") counts
     #   toward the majority that gates no-op gap fill (7d). ----
     is_pir = k == int(MsgKind.PREPARE_INST_REPLY)
+    # packed-bitmask identities for this replica / per-row senders
+    me_bit = (jnp.int32(1) << state.me).astype(jnp.uint16)
+    src_bit = (jnp.int32(1) << jnp.clip(inbox.src, 0, R - 1)).astype(
+        jnp.uint16)
     rel_v, in_win_v = _rel(state, inbox.inst, S)
     rel_v_safe = jnp.minimum(rel_v, S - 1)
     pv_ok = (
@@ -356,9 +368,8 @@ def replica_step_impl(
         & in_win_v
     )
     state = state._replace(
-        pvotes=state.pvotes.at[
-            jnp.where(pv_ok, rel_v, S), jnp.clip(inbox.src, 0, R - 1)
-        ].set(True, mode="drop"))
+        pvotes=state.pvotes | scatter_vote_bits(S, rel_v, inbox.src,
+                                                pv_ok, R))
     pir_ok = (
         pv_ok
         & (state.status[rel_v_safe] < COMMITTED)
@@ -371,17 +382,15 @@ def replica_step_impl(
     tgt_v = jnp.where(pir_win, rel_v, S)
     state = state._replace(
         ballot=state.ballot.at[tgt_v].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_v].set(ACCEPTED, mode="drop"),
-        op=state.op.at[tgt_v].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt_v].set(jnp.uint8(ACCEPTED), mode="drop"),
+        op=state.op.at[tgt_v].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt_v].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt_v].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt_v].set(inbox.val_hi, mode="drop"),
         val_lo=state.val_lo.at[tgt_v].set(inbox.val_lo, mode="drop"),
         cmd_id=state.cmd_id.at[tgt_v].set(inbox.cmd_id, mode="drop"),
         client_id=state.client_id.at[tgt_v].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_v].set(
-            jnp.broadcast_to(jax.nn.one_hot(state.me, R, dtype=bool), (M, R)),
-            mode="drop"),
+        votes=state.votes.at[tgt_v].set(me_bit, mode="drop"),
         crt_inst=jnp.maximum(
             state.crt_inst, jnp.max(jnp.where(pir_ok, inbox.inst, -1)) + 1),
     )
@@ -416,8 +425,8 @@ def replica_step_impl(
     tgt = jnp.where(acc_ok, rel_a, S)  # S drops
     state = state._replace(
         ballot=state.ballot.at[tgt].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt].set(ACCEPTED, mode="drop"),
-        op=state.op.at[tgt].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt].set(jnp.uint8(ACCEPTED), mode="drop"),
+        op=state.op.at[tgt].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt].set(inbox.val_hi, mode="drop"),
@@ -425,8 +434,7 @@ def replica_step_impl(
         cmd_id=state.cmd_id.at[tgt].set(inbox.cmd_id, mode="drop"),
         client_id=state.client_id.at[tgt].set(inbox.client_id, mode="drop"),
         # accepting a newer ballot supersedes any older votes
-        votes=state.votes.at[tgt].set(
-            jax.nn.one_hot(inbox.src, R, dtype=bool), mode="drop"),
+        votes=state.votes.at[tgt].set(src_bit, mode="drop"),
         default_ballot=jnp.maximum(state.default_ballot,
                                    jnp.max(jnp.where(is_accept, inbox.ballot, NO_BALLOT))),
         max_recv_ballot=jnp.maximum(state.max_recv_ballot,
@@ -571,8 +579,8 @@ def replica_step_impl(
     tgt_c = jnp.where(com_ok, rel_c, S)
     state = state._replace(
         ballot=state.ballot.at[tgt_c].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_c].max(COMMITTED, mode="drop"),
-        op=state.op.at[tgt_c].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt_c].max(jnp.uint8(COMMITTED), mode="drop"),
+        op=state.op.at[tgt_c].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt_c].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt_c].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt_c].set(inbox.val_hi, mode="drop"),
@@ -614,19 +622,17 @@ def replica_step_impl(
     rel_p = slots - state.window_base
     fits = prop & (rel_p >= 0) & (rel_p < S)
     tgt_p = jnp.where(fits, rel_p, S)
-    self_vote = jax.nn.one_hot(state.me, R, dtype=bool)
     state = state._replace(
         ballot=state.ballot.at[tgt_p].set(state.default_ballot, mode="drop"),
-        status=state.status.at[tgt_p].set(ACCEPTED, mode="drop"),
-        op=state.op.at[tgt_p].set(inbox.op, mode="drop"),
+        status=state.status.at[tgt_p].set(jnp.uint8(ACCEPTED), mode="drop"),
+        op=state.op.at[tgt_p].set(inbox.op.astype(jnp.uint8), mode="drop"),
         key_hi=state.key_hi.at[tgt_p].set(inbox.key_hi, mode="drop"),
         key_lo=state.key_lo.at[tgt_p].set(inbox.key_lo, mode="drop"),
         val_hi=state.val_hi.at[tgt_p].set(inbox.val_hi, mode="drop"),
         val_lo=state.val_lo.at[tgt_p].set(inbox.val_lo, mode="drop"),
         cmd_id=state.cmd_id.at[tgt_p].set(inbox.cmd_id, mode="drop"),
         client_id=state.client_id.at[tgt_p].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_p].set(
-            jnp.broadcast_to(self_vote, (M, R)), mode="drop"),
+        votes=state.votes.at[tgt_p].set(me_bit, mode="drop"),
         crt_inst=state.crt_inst + jnp.where(fits, 1, 0).sum(),
     )
     # broadcast ACCEPT rows for accepted proposals; rejection replies
@@ -674,7 +680,7 @@ def replica_step_impl(
         inbox.last_committed)
     replied = pc_seen[:R] > -(2 ** 30)
     state = state._replace(
-        votes=state.votes | vote_cov,
+        votes=state.votes | pack_vote_bits(vote_cov),
         max_recv_ballot=jnp.maximum(
             state.max_recv_ballot,
             jnp.max(jnp.where(is_accept_reply, inbox.ballot, NO_BALLOT))),
@@ -683,7 +689,7 @@ def replica_step_impl(
 
     # ---- 7. commit scan ----
     idx_abs = state.window_base + jnp.arange(S, dtype=jnp.int32)
-    n_votes = state.votes.sum(axis=1)
+    n_votes = jax.lax.population_count(state.votes).astype(jnp.int32)
     if cfg.explicit_commit:
         # classic: each instance commits at its OWN ballot (votes are
         # reset whenever a slot's ballot changes, so n_votes counts
@@ -788,7 +794,7 @@ def replica_step_impl(
         ballot=jnp.full(K, state.default_ballot, jnp.int32),
         inst=cu_slots,
         last_committed=jnp.full(K, state.committed_upto, jnp.int32),
-        op=state.op[cu_rel_safe],
+        op=state.op[cu_rel_safe].astype(jnp.int32),
         key_hi=state.key_hi[cu_rel_safe],
         key_lo=state.key_lo[cu_rel_safe],
         val_hi=state.val_hi[cu_rel_safe],
@@ -817,7 +823,8 @@ def replica_step_impl(
     # phase-1 safety condition; the old time-based heuristic
     # (stall_ticks >= noop_delay) could fill a slot whose committed
     # value simply hadn't been transferred yet.
-    pv_cnt = state.pvotes[rt_rel_safe].sum(axis=1)
+    pv_cnt = jax.lax.population_count(
+        state.pvotes[rt_rel_safe]).astype(jnp.int32)
     noop_fill = rt_empty & (pv_cnt >= majority)
     # A slot holding a value adopted from phase-1 answers (ballot !=
     # default_ballot) may be re-driven at the current ballot ONLY after
@@ -840,15 +847,14 @@ def replica_step_impl(
     state = state._replace(
         ballot=state.ballot.at[tgt_b].set(state.default_ballot, mode="drop"),
         status=state.status.at[jnp.where(noop_fill, rt_rel, S)].set(
-            ACCEPTED, mode="drop"),
-        op=state.op.at[jnp.where(noop_fill, rt_rel, S)].set(0, mode="drop"),
+            jnp.uint8(ACCEPTED), mode="drop"),
+        op=state.op.at[jnp.where(noop_fill, rt_rel, S)].set(
+            jnp.uint8(0), mode="drop"),
         cmd_id=state.cmd_id.at[jnp.where(noop_fill, rt_rel, S)].set(
             0, mode="drop"),
         client_id=state.client_id.at[jnp.where(noop_fill, rt_rel, S)].set(
             -1, mode="drop"),
-        votes=state.votes.at[tgt_b].set(
-            jnp.broadcast_to(jax.nn.one_hot(state.me, R, dtype=bool), (K, R)),
-            mode="drop"),
+        votes=state.votes.at[tgt_b].set(me_bit, mode="drop"),
     )
     rt = MsgBatch(
         kind=jnp.where(rt_ok, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
@@ -856,7 +862,7 @@ def replica_step_impl(
         ballot=jnp.full(K, state.default_ballot, jnp.int32),
         inst=rt_slots,
         last_committed=jnp.full(K, state.committed_upto, jnp.int32),
-        op=state.op[rt_rel_safe],
+        op=state.op[rt_rel_safe].astype(jnp.int32),
         key_hi=state.key_hi[rt_rel_safe],
         key_lo=state.key_lo[rt_rel_safe],
         val_hi=state.val_hi[rt_rel_safe],
@@ -894,9 +900,11 @@ def replica_step_impl(
         inst=pi_slots,
     )
     state = state._replace(
-        # the leader answers its own phase 1 as it sweeps
-        pvotes=state.pvotes.at[
-            jnp.where(pi_ok, pi_rel, S), state.me].set(True, mode="drop"),
+        # the leader answers its own phase 1 as it sweeps (duplicate
+        # indices write the same constant me_bit, so plain .set is a
+        # safe scatter-OR here)
+        pvotes=state.pvotes | jnp.zeros(S, jnp.uint16).at[
+            jnp.where(pi_ok, pi_rel, S)].set(me_bit, mode="drop"),
         rec_cursor=jnp.where(
             sweep_on, jnp.minimum(cursor + K2, state.crt_inst), cursor),
     )
@@ -918,9 +926,10 @@ def replica_step_impl(
     rel_e = exec_lo - state.window_base + jnp.arange(E, dtype=jnp.int32)
     evalid = jnp.arange(E) < n_exec
     rel_e_safe = jnp.clip(rel_e, 0, S - 1)
+    op_e = jnp.where(evalid, state.op[rel_e_safe].astype(jnp.int32), 0)
     kv, o_hi, o_lo, o_found = kv_apply_batch(
         state.kv,
-        jnp.where(evalid, state.op[rel_e_safe], 0),
+        op_e,
         state.key_hi[rel_e_safe],
         state.key_lo[rel_e_safe],
         state.val_hi[rel_e_safe],
@@ -937,7 +946,7 @@ def replica_step_impl(
     )
     execr = ExecResult(
         lo=exec_lo, count=n_exec, val_hi=o_hi, val_lo=o_lo, found=o_found,
-        op=jnp.where(evalid, state.op[rel_e_safe], 0),
+        op=op_e,
         cmd_id=jnp.where(evalid, state.cmd_id[rel_e_safe], 0),
         client_id=jnp.where(evalid, state.client_id[rel_e_safe], 0),
     )
@@ -977,8 +986,8 @@ def replica_step_impl(
             val_lo=slide(state.val_lo, 0),
             cmd_id=slide(state.cmd_id, 0),
             client_id=slide(state.client_id, 0),
-            votes=slide(state.votes, False),
-            pvotes=slide(state.pvotes, False),
+            votes=slide(state.votes, 0),
+            pvotes=slide(state.pvotes, 0),
             window_base=state.window_base + shift,
         )
     return state, Outbox(msgs=out, dst=dst, acked=ack_ok_row), execr

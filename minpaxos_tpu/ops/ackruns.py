@@ -77,3 +77,29 @@ def range_vote_coverage(valid: jnp.ndarray, src: jnp.ndarray,
     vd = vd.at[jnp.where(vrow, src_c, r),
                jnp.where(vrow, hi_rel, s)].add(-1, mode="drop")
     return (jnp.cumsum(vd, axis=1)[:, :s] > 0).T
+
+
+def pack_vote_bits(cov: jnp.ndarray) -> jnp.ndarray:
+    """bool[S, R] -> u16[S] bitmask (bit r = replica r voted).
+
+    Votes/pvotes live as packed u16 per slot — R <= 16 by the ballot
+    encoding ((counter << 4) | id, bareminpaxos.go:383-385) — so the
+    two densest per-slot arrays cost 2 bytes instead of R bool bytes.
+    The bool intermediate here is transient (XLA fuses it); only the
+    packed form persists in HBM across steps."""
+    r = cov.shape[1]
+    w = (jnp.int32(1) << jnp.arange(r, dtype=jnp.int32))[None, :]
+    return (cov.astype(jnp.int32) * w).sum(axis=1).astype(jnp.uint16)
+
+
+def scatter_vote_bits(size: int, idx: jnp.ndarray, src: jnp.ndarray,
+                      valid: jnp.ndarray, n_replicas: int) -> jnp.ndarray:
+    """OR-delta u16[size]: bit ``src[i]`` set at row ``idx[i]`` for
+    every valid i. Safe under duplicates AND multiple senders hitting
+    one slot in a batch (a plain scatter-max/add cannot express that):
+    scatter booleans into a transient [R, size] plane, then pack."""
+    r = n_replicas
+    d = jnp.zeros((r, size), bool).at[
+        jnp.where(valid, jnp.clip(src, 0, r - 1), r),
+        jnp.where(valid, idx, size)].set(True, mode="drop")
+    return pack_vote_bits(d.T)

@@ -151,7 +151,14 @@ def kv_insert_unique(kv: KVState, k_hi, k_lo, v, delete, valid) -> KVState:
         match = (s != EMPTY) & (kv.key_hi[pos] == k_hi) & (kv.key_lo[pos] == k_lo)
         empty = s == EMPTY
         want = pending & (match | empty)
-        # claim: lowest row index wins each contested slot
+        # claim: lowest row index wins each contested slot. The claim
+        # array is capacity-length, so per-iteration cost scales with
+        # the TABLE SIZE — size kv_pow2 to the workload, not "huge"
+        # (a 2^20 default table measurably halved TCP throughput,
+        # round 4). A B-sized stable-sort winner pick was tried and
+        # MEASURED SLOWER at every deployed shape (argsort per
+        # iteration beats the [C] scatter only past ~2^20 capacity);
+        # revisit only with a device profile in hand.
         claims = jnp.full(c, big).at[jnp.where(want, pos, c)].min(
             jnp.where(want, rows, big), mode="drop")
         won = want & (claims[pos] == rows)

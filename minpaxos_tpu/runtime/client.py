@@ -203,6 +203,43 @@ class Client:
                 "duplicates": self.dup_replies,
                 "missing": n - done}
 
+    def run_partition(self, idx: np.ndarray, ops, keys, vals,
+                      batch: int = 512, timeout_s: float = 60.0) -> dict:
+        """run_workload over an explicit cmd_id subset (`idx`), keeping
+        the GLOBAL ids — the per-connection driver MultiClient uses."""
+        n = len(idx)
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        if self.sock is None:
+            self.connect(self.connected_to
+                         if getattr(self, "connected_to", None) is not None
+                         else None)
+        cursor = 0
+        while time.monotonic() < deadline:
+            with self._lock:
+                done = sum(1 for c in idx if int(c) in self.replies)
+            if done >= n:
+                break
+            if cursor >= n:
+                cursor = 0
+            window = [int(c) for c in idx[cursor:cursor + batch]
+                      if int(c) not in self.replies]
+            cursor += batch
+            if not window:
+                continue
+            w = np.asarray(window)
+            try:
+                self.propose(w, ops[w], keys[w], vals[w])
+                ok = self.wait(w, timeout_s=3.0)
+            except OSError:
+                ok = False
+            if not ok:
+                self._failover()
+        with self._lock:
+            done = sum(1 for c in idx if int(c) in self.replies)
+        return {"sent": n, "acked": done,
+                "duplicates": self.dup_replies, "missing": n - done}
+
     def _failover(self) -> None:
         """Leader died or rejected us: prefer its hint, else ask the
         master, else scan replicas for any that accepts TCP
@@ -224,3 +261,90 @@ class Client:
             except OSError:
                 continue
         time.sleep(0.5)
+
+
+class MultiClient:
+    """One connection per replica: the reference client's multi-target
+    send modes (client.go:19-31, send paths :148-209).
+
+    * ``mode="rr"`` — leaderless round-robin (`-e`): command i goes to
+      replica i % N on that replica's own connection. This is the
+      natural Mencius driver — every owner serves proposals into its
+      own slots concurrently, which is the whole point of the
+      protocol; a single hinted proposer makes the other owners cede
+      every slot (BENCH_TCP round 3: mencius at half of minpaxos).
+    * ``mode="fast"`` — fast mode (`-f`): every command goes to ALL
+      replicas; the first success reply on any connection wins.
+      Non-leaders reject (MinPaxos/classic), so exactly one success
+      arrives per command; with -check, per-connection reply books
+      keep rejections from counting as duplicates. Not meaningful for
+      Mencius (each owner would commit the command into its own slot
+      = N× execution).
+
+    Exactly-once bookkeeping is per connection (the server replies on
+    the proposing connection only), so sub-clients never see each
+    other's replies; stats aggregate across them.
+    """
+
+    def __init__(self, maddr: tuple[str, int], check: bool = False,
+                 mode: str = "rr"):
+        assert mode in ("rr", "fast")
+        self.mode = mode
+        self.nodes = get_replica_list(maddr)
+        self.clients: list[Client] = []
+        for rid in range(len(self.nodes)):
+            c = Client(maddr, check=check)
+            c.connect(rid)
+            self.clients.append(c)
+
+    def run_workload(self, ops, keys, vals, batch: int = 512,
+                     timeout_s: float = 60.0) -> dict:
+        n = len(ops)
+        t0 = time.monotonic()
+        if self.mode == "rr":
+            parts = [np.arange(n)[np.arange(n) % len(self.clients) == r]
+                     for r in range(len(self.clients))]
+            results: list[dict | None] = [None] * len(self.clients)
+
+            def drive(r):
+                results[r] = self.clients[r].run_partition(
+                    parts[r], ops, keys, vals, batch=batch,
+                    timeout_s=timeout_s)
+
+            threads = [threading.Thread(target=drive, args=(r,),
+                                        daemon=True)
+                       for r in range(len(self.clients))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=timeout_s + 10)
+            done = sum(r["acked"] for r in results if r)
+            dups = sum(r["duplicates"] for r in results if r)
+        else:  # fast: fan out to all, first success wins
+            deadline = t0 + timeout_s
+            for lo in range(0, n, batch):
+                idx = np.arange(lo, min(lo + batch, n))
+                for c in self.clients:
+                    try:
+                        c.propose(idx, ops[idx], keys[idx], vals[idx])
+                    except OSError:
+                        pass  # that replica is down; others cover
+                while time.monotonic() < deadline:
+                    if all(any(int(i) in c.replies for c in self.clients)
+                           for i in idx):
+                        break
+                    time.sleep(0.002)
+            done = sum(1 for i in range(n)
+                       if any(i in c.replies for c in self.clients))
+            # a duplicate = the SAME connection receiving two success
+            # replies for one cmd (cross-connection replies are the
+            # mode's design, not duplicates)
+            dups = sum(c.dup_replies for c in self.clients)
+        wall = time.monotonic() - t0
+        return {"sent": n, "acked": done, "wall_s": wall,
+                "ops_per_s": done / wall if wall > 0 else 0.0,
+                "duplicates": dups, "missing": n - done}
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close_conn()

@@ -1,0 +1,75 @@
+"""Worker process for the two-process SPMD test (run via subprocess).
+
+Usage: python _multihost_worker.py <coordinator_port> <process_id> <out_file>
+
+Each of the 2 processes owns 4 virtual CPU devices; the global mesh is
+8 devices along 'shard'. Every process runs the SAME fused program;
+each asserts commits on its OWN addressable slice, then writes a JSON
+line to its out_file. This is the real multi-controller shape of
+parallel/multihost.py — the degenerate single-process test can't catch
+a mesh/addressability bug.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    port, pid, out_file = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from minpaxos_tpu.parallel import multihost
+    from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+    from minpaxos_tpu.parallel.sharded import (
+        elect_all,
+        init_sharded,
+        make_propose_ext,
+        sharded_step,
+    )
+
+    multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=2, process_id=pid)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8, jax.devices()
+
+    mesh = multihost.global_shard_mesh(1)
+    n_shards = 8
+    my_slice = multihost.process_shard_slice(n_shards)
+
+    cfg = MinPaxosConfig(n_replicas=3, window=128, inbox=128,
+                         exec_batch=32, kv_pow2=8, catchup_rows=8,
+                         recovery_rows=8)
+    ss = init_sharded(cfg, n_shards, mesh)
+    ss = elect_all(cfg, ss, 0)
+
+    quiet = make_propose_ext(cfg, n_shards, cfg.inbox, 0,
+                             jnp.int32(0), jnp.int32(0))
+    ext = make_propose_ext(cfg, n_shards, cfg.inbox, 16,
+                           jnp.int32(0), jnp.int32(1))
+    for e in (quiet, quiet, ext, quiet, quiet, quiet):
+        ss, execr, _, _ = sharded_step(cfg, ss, e)
+
+    upto = ss.states.committed_upto[:, 0]
+    local = np.concatenate(
+        [np.asarray(s.data).reshape(-1) for s in upto.addressable_shards])
+    rec = {
+        "process": pid,
+        "n_local_shards": int(local.size),
+        "min_committed": int(local.min()),
+        "my_slice": [my_slice.start, my_slice.stop],
+        "ok": bool(local.size == 4 and (local >= 15).all()),
+    }
+    with open(out_file, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

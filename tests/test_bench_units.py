@@ -107,6 +107,55 @@ def test_keybuf_amortized_append_and_view():
     assert kb2.view().tolist() == [(7 << 32) | 9]
 
 
+def test_wait_for_backend_retries_then_gives_up():
+    from minpaxos_tpu.utils.backend import wait_for_backend
+
+    calls = []
+
+    def dead_probe(t):
+        calls.append(t)
+        return None
+
+    sleeps = []
+    out = wait_for_backend(attempts=3, probe=dead_probe,
+                           sleep=sleeps.append, retry_sleep_s=7)
+    assert out is None and len(calls) == 3
+    assert sleeps == [7, 7]  # no sleep after the final attempt
+
+    # recovers mid-way
+    seq = iter([None, "axon"])
+    out = wait_for_backend(attempts=5, probe=lambda t: next(seq),
+                           sleep=lambda s: None)
+    assert out == "axon"
+
+    # cpu-only backend rejected when a real chip is required...
+    out = wait_for_backend(attempts=2, probe=lambda t: "cpu",
+                           sleep=lambda s: None)
+    assert out is None
+    # ...but accepted when not
+    out = wait_for_backend(attempts=1, probe=lambda t: "cpu",
+                           want_non_cpu=False)
+    assert out == "cpu"
+
+
+def test_probe_backend_real_subprocess_cpu():
+    """probe_backend spawns a real python; with the CPU platform pinned
+    in the environment it must report 'cpu' (the probe inherits env)."""
+    import os
+
+    from minpaxos_tpu.utils.backend import probe_backend
+
+    old = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        assert probe_backend(timeout_s=120.0) == "cpu"
+    finally:
+        if old is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = old
+
+
 def test_keybuf_contains_matches_isin():
     from minpaxos_tpu.models.cluster import KeyBuf, pack_reply_key
 

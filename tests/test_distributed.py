@@ -411,6 +411,40 @@ def test_cpuprofile_captures_protocol_thread(harness):
     cli.close_conn()
 
 
+def test_multiclient_rr_drives_all_mencius_owners(harness):
+    """The -e leaderless round-robin client (reference client.go:19-31)
+    drives EVERY Mencius owner concurrently — the protocol's intended
+    workload (a single hinted proposer makes every other owner cede
+    each slot). Exactly-once must hold across the N connections and
+    every owner must actually serve proposals."""
+    from minpaxos_tpu.runtime.client import MultiClient
+
+    h = harness(mencius=True)
+    mc = MultiClient(("127.0.0.1", h.mport), check=True, mode="rr")
+    ops, keys, vals = gen_workload(300, seed=91)
+    stats = mc.run_workload(ops, keys, vals, timeout_s=60)
+    assert stats["acked"] == 300, stats
+    assert stats["duplicates"] == 0
+    served = [h.servers[r].stats["proposals"] for r in range(3)]
+    assert all(s > 0 for s in served), served
+    mc.close()
+
+
+def test_multiclient_fast_mode_first_reply_wins(harness):
+    """The -f fast mode (reference client.go -f) fans every command to
+    all replicas; non-leaders reject, the leader's reply wins, and the
+    per-connection books see no duplicates."""
+    from minpaxos_tpu.runtime.client import MultiClient
+
+    h = harness()
+    mc = MultiClient(("127.0.0.1", h.mport), check=True, mode="fast")
+    ops, keys, vals = gen_workload(200, seed=92)
+    stats = mc.run_workload(ops, keys, vals, timeout_s=60)
+    assert stats["acked"] == 200, stats
+    assert stats["duplicates"] == 0
+    mc.close()
+
+
 def test_mencius_over_tcp(harness):
     """Mencius as a real TCP server protocol (server -m): the
     reference compiled mencius but commented it out of server.go:58-79
