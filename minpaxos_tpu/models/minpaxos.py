@@ -134,8 +134,10 @@ class Outbox(NamedTuple):
 
     ACCEPT_REPLY rows are run-length compressed: only the first row of
     each maximal contiguous (sender, ok, consecutive inst) run is live,
-    with cmd_id carrying the run length (the wire ``count``,
-    minpaxosproto.go:75-80); the other rows of the run are padding.
+    with cmd_id carrying the run length (the wire ``count`` — this
+    repo's extension to AcceptReply, minpaxosproto.go:75-80, modeled on
+    CommitShort's Instance+Count range, paxosproto.go:50-54); the
+    other rows of the run are padding.
     ``acked`` therefore exists as the durability hook: bool per INBOX
     row, True where an inbox ACCEPT row was accepted (or re-acked as
     identical-committed) this step — the host's _persist reads it
@@ -465,7 +467,8 @@ def replica_step_impl(
     # ack every ACCEPT row (ok=0 NACK carries our promised ballot),
     # run-length compressed: one reply row per maximal contiguous
     # (sender, ok, consecutive inst) run instead of one per slot, with
-    # cmd_id = run length (wire `count`, minpaxosproto.go:75-80). The
+    # cmd_id = run length (wire `count` — our AcceptReply extension,
+    # modeled on CommitShort's range form, paxosproto.go:50-54). The
     # leader consumes the range in step 6. This kills the round-3
     # ack-row explosion — (R-1)*p per-slot ack rows per round through
     # the routing fabric collapse to ~1 per follower, which is what

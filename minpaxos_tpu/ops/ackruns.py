@@ -2,8 +2,10 @@
 
 The ack-row explosion fix (round 4): a replica acking p contiguous
 ACCEPT rows emits ONE live ACCEPT_REPLY row whose cmd_id carries the
-run length (the wire ``count``, reference minpaxosproto.go:75-80
-AcceptReply batching), and the driving replica consumes the range with
+run length (the wire ``count`` — this repo's own wire extension to
+AcceptReply, modeled on the reference's CommitShort{Instance, Count}
+range message, paxosproto.go:50-54 / minpaxosproto.go AcceptReply
+itself has no Count field), and the driving replica consumes the range with
 a per-sender difference array + prefix sum instead of one scatter per
 slot. Both halves live here so the subtle index arithmetic cannot
 drift between the MinPaxos and Mencius kernels — they MUST stay in

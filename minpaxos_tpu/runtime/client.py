@@ -170,37 +170,13 @@ class Client:
         with the same cmd_ids. Returns stats incl. -check results."""
         n = len(ops)
         t0 = time.monotonic()
-        deadline = t0 + timeout_s
-        if self.sock is None:
-            self.connect()
-        cursor = 0
-        while time.monotonic() < deadline:
-            with self._lock:
-                done = len(self.replies)
-            if done >= n:
-                break
-            if cursor >= n:
-                cursor = 0  # sweep again for commands lost to failover
-            # (re)send the next window of unacked commands
-            unacked = [c for c in range(cursor, min(cursor + batch, n))
-                       if c not in self.replies]
-            cursor += batch
-            if not unacked:
-                continue
-            idx = np.asarray(unacked)
-            try:
-                self.propose(idx, ops[idx], keys[idx], vals[idx])
-                ok = self.wait(idx, timeout_s=3.0)
-            except OSError:
-                ok = False
-            if not ok:
-                self._failover()
+        stats = self.run_partition(np.arange(n), ops, keys, vals,
+                                   batch=batch, timeout_s=timeout_s)
         wall = time.monotonic() - t0
-        with self._lock:
-            done = len(self.replies)
+        done = stats["acked"]
         return {"sent": n, "acked": done, "wall_s": wall,
                 "ops_per_s": done / wall if wall > 0 else 0.0,
-                "duplicates": self.dup_replies,
+                "duplicates": stats["duplicates"],
                 "missing": n - done}
 
     def run_partition(self, idx: np.ndarray, ops, keys, vals,
@@ -214,27 +190,31 @@ class Client:
             self.connect(self.connected_to
                          if getattr(self, "connected_to", None) is not None
                          else None)
-        cursor = 0
-        while time.monotonic() < deadline:
+        # persistent pending list; each loop filters only the HEAD
+        # window under the lock (O(batch), so the reader thread is
+        # never stalled behind an O(n) scan), and unacked heads are
+        # pushed back for retry — an id leaves pending only acked, so
+        # commands lost to failover are re-swept without a cursor
+        pending = [int(c) for c in idx]
+        while pending and time.monotonic() < deadline:
             with self._lock:
-                done = sum(1 for c in idx if int(c) in self.replies)
-            if done >= n:
-                break
-            if cursor >= n:
-                cursor = 0
-            window = [int(c) for c in idx[cursor:cursor + batch]
-                      if int(c) not in self.replies]
-            cursor += batch
-            if not window:
+                head = [c for c in pending[:batch]
+                        if c not in self.replies]
+            tail = pending[batch:]
+            if not head:
+                pending = tail
                 continue
-            w = np.asarray(window)
+            w = np.asarray(head)
             try:
                 self.propose(w, ops[w], keys[w], vals[w])
                 ok = self.wait(w, timeout_s=3.0)
             except OSError:
                 ok = False
-            if not ok:
+            if ok:
+                pending = tail
+            else:
                 self._failover()
+                pending = head + tail
         with self._lock:
             done = sum(1 for c in idx if int(c) in self.replies)
         return {"sent": n, "acked": done,
@@ -328,7 +308,16 @@ class MultiClient:
                     try:
                         c.propose(idx, ops[idx], keys[idx], vals[idx])
                     except OSError:
-                        pass  # that replica is down; others cover
+                        # dead connection: re-dial the SAME replica (fast
+                        # mode offers every command to every replica, so
+                        # failing over elsewhere would double-offer) and
+                        # retry once; if the replica itself is down the
+                        # others cover
+                        try:
+                            c.connect(c.connected_to)
+                            c.propose(idx, ops[idx], keys[idx], vals[idx])
+                        except OSError:
+                            pass
                 while time.monotonic() < deadline:
                     if all(any(int(i) in c.replies for c in self.clients)
                            for i in idx):

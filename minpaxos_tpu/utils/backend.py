@@ -27,19 +27,27 @@ import tempfile
 import time
 
 
-def probe_backend(timeout_s: float = 120.0) -> str | None:
+def probe_backend(timeout_s: float = 120.0,
+                  env: dict[str, str] | None = None) -> str | None:
     """Subprocess probe: the default backend's platform name, or None
     if init fails/hangs. Popen + DEVNULL + process-group kill, NOT
     subprocess.run with capture_output: a hung backend init can leave
     grandchildren (tunnel helpers) holding the output pipes, and
-    run()'s post-kill communicate() then blocks forever."""
+    run()'s post-kill communicate() then blocks forever.
+
+    ``env`` overrides the child environment (None = inherit). Callers
+    that want a relay-independent probe (e.g. tests of the playbook
+    itself) must strip PYTHONPATH here: the tunnel's sitecustomize
+    rides PYTHONPATH and dials the relay at jax-import time even under
+    JAX_PLATFORMS=cpu, so an inherited env ties the probe's fate to
+    the relay's mood."""
     with tempfile.NamedTemporaryFile("r", suffix=".probe") as tf:
         p = subprocess.Popen(
             [sys.executable, "-c",
              "import jax, pathlib; pathlib.Path("
              f"{tf.name!r}).write_text(jax.devices()[0].platform)"],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            start_new_session=True)
+            env=env, start_new_session=True)
         try:
             rc = p.wait(timeout=timeout_s)
             platform = tf.read().strip()

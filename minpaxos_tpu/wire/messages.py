@@ -134,8 +134,9 @@ SCHEMAS: dict[MsgKind, np.dtype] = {
         [("leader_id", "i1"), ("inst", "<i4"), ("ballot", "<i4"),
          ("last_committed", "<i4")] + _CMD_FIELDS),
     # AcceptReply{Instance, OK, Ballot, Id} — minpaxosproto.go:75-80,
-    # extended with count so one row acks the contiguous range
-    # [inst, inst+count).
+    # extended with count (this repo's wire extension, modeled on the
+    # reference's CommitShort{Instance, Count} range message,
+    # paxosproto.go:50-54) so one row acks [inst, inst+count).
     MsgKind.ACCEPT_REPLY: np.dtype(
         [("id", "i1"), ("ok", "u1"), ("inst", "<i4"), ("count", "<i4"),
          ("ballot", "<i4"), ("last_committed", "<i4")]),

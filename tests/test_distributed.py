@@ -176,10 +176,17 @@ def test_kv_saturation_fails_stop(harness):
     ops = np.full(n, 1, np.int64)  # Op.PUT
     keys = np.arange(n, dtype=np.int64) + 1000
     vals = np.arange(n, dtype=np.int64)
-    cli.run_workload(ops, keys, vals, timeout_s=8)
-    deadline = time.monotonic() + 15
+    # keep driving the saturating workload until a replica fail-stops:
+    # one bounded run + a fixed poll was timing-flaky (a slow follower
+    # may not have stepped its dropped insert yet when the poll ends)
+    deadline = time.monotonic() + 60
     fatal = None
     while time.monotonic() < deadline and fatal is None:
+        cli.replies.clear()  # else a fully-acked run makes every later
+        try:                 # run_workload return without proposing
+            cli.run_workload(ops, keys, vals, timeout_s=5)
+        except OSError:
+            pass  # the proposed-to replica may itself have fail-stopped
         for s in h.servers.values():
             if s.fatal is not None:
                 fatal = s.fatal
