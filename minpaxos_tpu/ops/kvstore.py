@@ -16,9 +16,14 @@ call while preserving the reference's sequential semantics:
 Mechanics: rows are sorted by (key, slot) with ``jnp.lexsort``; "the
 last write to my key before me" becomes an exclusive segmented
 max-scan (ops/scan.py) over the sorted order; final writers per key
-(segment maxima) are inserted into an open-addressing hash table via a
-parallel claim loop. Everything is fixed-shape and branch-free, so XLA
-compiles it once per batch size.
+(segment maxima) are inserted into a bucketized two-choice hash table
+(W ways per bucket, two candidate buckets per key) in a single
+LOOP-FREE pass. Everything is fixed-shape and branch-free — no
+``while_loop`` anywhere in the KV path — so XLA compiles it once per
+batch size and the table arrays never ride a loop carry (the round-4
+linear-probing engine made XLA copy all four table arrays through two
+while carries per protocol step, ~80MB of pure copy traffic per tick
+at kv_pow2=20).
 
 Keys are 64-bit on the wire and (hi, lo) i32 lane pairs on device
 (ops/packed.py). Values are a ``[*, L]`` i32 lane axis: the engine
@@ -44,10 +49,18 @@ from minpaxos_tpu.ops.packed import pair_hash
 from minpaxos_tpu.ops.scan import exclusive_segmented_scan_max, segmented_scan_max
 from minpaxos_tpu.wire.messages import Op
 
-# Slot states in the table. DELETED keeps its key (delete-in-place):
-# probe chains stay intact and PUT/DELETE churn on a key reuses its
-# slot instead of consuming capacity.
-EMPTY, LIVE, DELETED = 0, 1, 2
+# Slot states in the table. Buckets have no probe chains to preserve,
+# so DELETE frees its slot outright (EMPTY) and churn on a key reuses
+# capacity immediately; no tombstone state is needed.
+EMPTY, LIVE = 0, 1
+
+# Ways per bucket. A key hashes to two candidate buckets and may live
+# in any of their 2*W ways — a fixed 2*W-slot gather replaces the
+# round-4 linear-probe while_loop (power-of-two-choices keeps the max
+# bucket load near the average, so placement failures are a sizing
+# error, not a hashing accident; they are counted in kv.dropped and
+# the TCP runtime fail-stops on them). Minimum table: one bucket.
+WAYS = 4
 
 # i32 lanes per value on the consensus path: one 8-byte wire value
 # (statemarsh.go:8-21). The engine itself is lane-generic — see module
@@ -60,56 +73,58 @@ class KVState(NamedTuple):
 
     key_hi: jnp.ndarray  # i32[C]
     key_lo: jnp.ndarray  # i32[C]
-    val: jnp.ndarray  # i32[C, L]
-    slot: jnp.ndarray  # i32[C]: EMPTY / LIVE / DELETED
+    val: jnp.ndarray  # i32[C, L] (lane-major [L, C] was tried and
+    # measured SLOWER: the axis-1 scatter it needs lowers far worse
+    # than the [C, L] row scatter's two residual copies)
+    slot: jnp.ndarray  # i32[C]: EMPTY / LIVE
     dropped: jnp.ndarray  # i32 scalar: inserts lost to a full table
 
 
 def kv_init(capacity_pow2: int, val_lanes: int = VAL_LANES) -> KVState:
     c = 1 << capacity_pow2
+    assert c >= WAYS, "table must hold at least one bucket"
     z = jnp.zeros(c, dtype=jnp.int32)
     return KVState(z, z, jnp.zeros((c, val_lanes), jnp.int32), z,
                    jnp.int32(0))
 
 
-def _probe_pos(h: jnp.ndarray, t: jnp.ndarray, mask: int) -> jnp.ndarray:
-    return ((h + t.astype(jnp.uint32)) & jnp.uint32(mask)).astype(jnp.int32)
+def _cand_pos(capacity: int, k_hi: jnp.ndarray, k_lo: jnp.ndarray):
+    """The 2*W candidate slot positions of each key: i32[B, 2W].
+
+    Bucket 1 from the primary hash; bucket 2 from an independent mix,
+    forced distinct from bucket 1 whenever the table has more than one
+    bucket (maximum placement flexibility at small tables)."""
+    nb = capacity // WAYS
+    h1 = pair_hash(k_hi, k_lo)
+    b1 = (h1 & jnp.uint32(nb - 1)).astype(jnp.int32)
+    if nb > 1:
+        h2 = pair_hash(k_lo ^ jnp.int32(0x2545F491), k_hi ^ jnp.int32(0x61C88647))
+        b2 = ((b1 + 1 + (h2 % jnp.uint32(nb - 1)).astype(jnp.int32)) % nb)
+    else:
+        b2 = b1
+    w = jnp.arange(WAYS, dtype=jnp.int32)
+    return jnp.concatenate(
+        [b1[:, None] * WAYS + w[None, :], b2[:, None] * WAYS + w[None, :]],
+        axis=1)
 
 
 def kv_lookup_lanes(kv: KVState, k_hi: jnp.ndarray, k_lo: jnp.ndarray,
                     valid: jnp.ndarray | None = None):
-    """Batched probe: returns (found bool[B], v i32[B, L])."""
+    """Batched lookup: returns (found bool[B], v i32[B, L]).
+
+    One fixed [B, 2W] gather of the two candidate buckets — loop-free."""
     c, lanes = kv.val.shape
-    mask = c - 1
-    h = pair_hash(k_hi, k_lo)
-    b = k_hi.shape[0]
     if valid is None:
-        valid = jnp.ones(b, dtype=bool)
-
-    def cond(carry):
-        t, done, _, _ = carry
-        return (~done).any() & (t < c)
-
-    def body(carry):
-        t, done, found, v = carry
-        pos = _probe_pos(h, jnp.full(b, t, jnp.int32), mask)
-        s = kv.slot[pos]
-        key_match = (s != EMPTY) & (kv.key_hi[pos] == k_hi) & (
-            kv.key_lo[pos] == k_lo)
-        empty = s == EMPTY
-        hit = ~done & key_match & (s == LIVE)
-        found = found | hit
-        v = jnp.where(hit[:, None], kv.val[pos], v)
-        done = done | key_match | empty
-        return t + 1, done, found, v
-
-    init = (
-        jnp.int32(0),
-        ~valid,
-        jnp.zeros(b, dtype=bool),
-        jnp.zeros((b, lanes), dtype=jnp.int32),
-    )
-    _, _, found, v = jax.lax.while_loop(cond, body, init)
+        valid = jnp.ones(k_hi.shape[0], dtype=bool)
+    pos = _cand_pos(c, k_hi, k_lo)
+    hit = ((kv.slot[pos] == LIVE) & (kv.key_hi[pos] == k_hi[:, None])
+           & (kv.key_lo[pos] == k_lo[:, None]) & valid[:, None])
+    found = hit.any(axis=1)
+    # at most one way holds a key; argmax picks it (0 when absent)
+    way = jnp.argmax(hit, axis=1)
+    v = jnp.where(found[:, None],
+                  kv.val[pos[jnp.arange(pos.shape[0]), way]],
+                  jnp.zeros((1, lanes), jnp.int32))
     return found, v
 
 
@@ -123,60 +138,109 @@ def kv_lookup(kv: KVState, k_hi: jnp.ndarray, k_lo: jnp.ndarray,
 def kv_insert_unique(kv: KVState, k_hi, k_lo, v, delete, valid) -> KVState:
     """Insert/overwrite/delete a batch of rows with DISTINCT keys.
 
-    ``v`` is i32[B, L]. Parallel claim loop: each pending row probes
-    its chain; rows that reach an empty or key-matching slot
-    scatter-min their row index into a claim array; winners write,
-    losers advance. Terminates in at most C rounds (far fewer in
-    practice at sane load factors). DELETE marks the slot DELETED in
-    place, keeping its key, so probe chains never break and churn
-    reuses the slot. Rows that exhaust the table are counted in
-    kv.dropped (callers should size kv_pow2 above the distinct-key
-    count; the TCP runtime fail-stops on dropped > 0 —
-    runtime/replica.py)."""
+    ``v`` is i32[B, L]. Entirely LOOP-FREE (round-5 redesign): one
+    [B, 2W] gather of each key's two candidate buckets resolves every
+    row's destination in a single pass, then ONE batch of four
+    scatters writes the table. Under the protocol steps' state
+    donation the scatters update in place, so total table traffic is
+    O(B) and independent of capacity — the round-4 linear-probe
+    engine's while carries made XLA copy all four table arrays per
+    step and materialize capacity-length claim arrays per probe
+    round.
+
+    Placement:
+
+    * a key already LIVE in a candidate way overwrites in place
+      (DELETE frees the slot outright — buckets have no probe chains
+      to preserve, so no tombstones);
+    * new keys choose the candidate bucket with more free ways
+      (power-of-two-choices), and batch-internal contention is solved
+      by W statically-unrolled claim rounds: each round, contending
+      rows scatter-min their row index into a bucket-count array
+      (C/W entries — NOT capacity-length, and never inside a traced
+      loop); the round-r winner of a bucket takes its r-th free way.
+      Sorts were measured ~0.9 ms per jnp.lexsort at B=4096 on the
+      CPU backend, so the rank-by-stable-sort formulation lost to
+      this by ~10x;
+    * rows whose bucket wins run out of free ways retry their other
+      bucket the same way, minus ways the first pass claimed (a
+      scatter-or bitmask over buckets);
+    * rows that fit in neither bucket are counted in kv.dropped
+      (callers should size kv_pow2 comfortably above the distinct-key
+      count, as with any bounded table; the TCP runtime fail-stops on
+      dropped > 0 — runtime/replica.py)."""
     c = kv.key_hi.shape[0]
-    mask = c - 1
     b = k_hi.shape[0]
-    h = pair_hash(k_hi, k_lo)
+    nb = c // WAYS
     big = jnp.int32(2**31 - 1)
     rows = jnp.arange(b, dtype=jnp.int32)
+    way_ix = jnp.arange(WAYS, dtype=jnp.int32)
 
-    def cond(carry):
-        kv, pending, t, _ = carry
-        return pending.any() & (t < c)
+    pos = _cand_pos(c, k_hi, k_lo)  # [B, 2W]
+    s = kv.slot[pos]
+    live_match = ((s == LIVE) & (kv.key_hi[pos] == k_hi[:, None])
+                  & (kv.key_lo[pos] == k_lo[:, None]))
+    has_match = live_match.any(axis=1)
+    match_pos = pos[rows, jnp.argmax(live_match, axis=1)]
 
-    def body(carry):
-        kv, pending, t, off = carry
-        pos = _probe_pos(h, off, mask)
-        s = kv.slot[pos]
-        match = (s != EMPTY) & (kv.key_hi[pos] == k_hi) & (kv.key_lo[pos] == k_lo)
-        empty = s == EMPTY
-        want = pending & (match | empty)
-        # claim: lowest row index wins each contested slot. The claim
-        # array is capacity-length, so per-iteration cost scales with
-        # the TABLE SIZE — size kv_pow2 to the workload, not "huge"
-        # (a 2^20 default table measurably halved TCP throughput,
-        # round 4). A B-sized stable-sort winner pick was tried and
-        # MEASURED SLOWER at every deployed shape (argsort per
-        # iteration beats the [C] scatter only past ~2^20 capacity);
-        # revisit only with a device profile in hand.
-        claims = jnp.full(c, big).at[jnp.where(want, pos, c)].min(
-            jnp.where(want, rows, big), mode="drop")
-        won = want & (claims[pos] == rows)
-        wpos = jnp.where(won, pos, c)
-        new_slot = jnp.where(delete, jnp.int32(DELETED), jnp.int32(LIVE))
-        kv = kv._replace(
-            key_hi=kv.key_hi.at[wpos].set(k_hi, mode="drop"),
-            key_lo=kv.key_lo.at[wpos].set(k_lo, mode="drop"),
-            val=kv.val.at[wpos].set(v, mode="drop"),
-            slot=kv.slot.at[wpos].set(new_slot, mode="drop"),
-        )
-        # losers and occupied-by-other rows advance their probe offset
-        advance = pending & ~won
-        return kv, pending & ~won, t + 1, jnp.where(advance, off + 1, off)
+    free = s == EMPTY  # [B, 2W]
+    free1, free2 = free[:, :WAYS], free[:, WAYS:]
+    bkt1, bkt2 = pos[:, 0] // WAYS, pos[:, WAYS] // WAYS
+    pref2 = free2.sum(axis=1) > free1.sum(axis=1)
+    place = valid & ~has_match & ~delete  # delete-of-absent is a no-op
 
-    init = (kv, valid, jnp.int32(0), jnp.zeros(b, dtype=jnp.int32))
-    kv, still_pending, _, _ = jax.lax.while_loop(cond, body, init)
-    return kv._replace(dropped=kv.dropped + still_pending.sum())
+    def assign(mask, bkt, fm):
+        """W claim rounds: the round-r winner of each bucket (lowest
+        contending row index, via scatter-min into an [NB] array)
+        takes the bucket's r-th free way."""
+        # way_of_rank[i, r]: which way holds the r-th free slot of
+        # row i's bucket (and whether rank r exists at all)
+        onehot = fm[:, None, :] & (jnp.cumsum(fm, axis=1)[:, None, :] - 1
+                                   == way_ix[None, :, None])
+        has_rank = onehot.any(axis=2)
+        way_of_rank = jnp.argmax(onehot, axis=2)
+        dest = jnp.full(b, -1, jnp.int32)
+        rem = mask
+        for r in range(WAYS):
+            claims = jnp.full(nb, big).at[
+                jnp.where(rem, bkt, nb)].min(
+                jnp.where(rem, rows, big), mode="drop")
+            won = rem & (claims[jnp.clip(bkt, 0, nb - 1)] == rows)
+            ok = won & has_rank[:, r]
+            dest = jnp.where(ok, bkt * WAYS + way_of_rank[:, r], dest)
+            # winners leave the contest placed or not: a bucket out of
+            # free ways can't place later rounds either
+            rem = rem & ~won
+        return dest >= 0, dest
+
+    # pass A: the emptier candidate bucket
+    tb = jnp.where(pref2, bkt2, bkt1)
+    placed_a, pos_a = assign(place, tb,
+                             jnp.where(pref2[:, None], free2, free1))
+    # pass B: overflow rows retry the other bucket, minus pass-A
+    # claims (a scatter-or way bitmask per bucket)
+    ob = jnp.where(pref2, bkt1, bkt2)
+    cl_bits = jnp.zeros(nb, jnp.int32).at[
+        jnp.where(placed_a, pos_a // WAYS, nb)].add(
+        jnp.where(placed_a, jnp.int32(1) << (pos_a % WAYS), 0),
+        mode="drop")
+    taken_b = (cl_bits[jnp.clip(ob, 0, nb - 1)][:, None]
+               >> way_ix[None, :]) & 1
+    fm_b = jnp.where(pref2[:, None], free1, free2) & (taken_b == 0)
+    placed_b, pos_b = assign(place & ~placed_a, ob, fm_b)
+
+    dest = jnp.where(valid & has_match, match_pos,
+                     jnp.where(placed_a, pos_a,
+                               jnp.where(placed_b, pos_b, -1)))
+    wpos = jnp.where(dest >= 0, dest, c)
+    new_slot = jnp.where(delete, jnp.int32(EMPTY), jnp.int32(LIVE))
+    return kv._replace(
+        key_hi=kv.key_hi.at[wpos].set(k_hi, mode="drop"),
+        key_lo=kv.key_lo.at[wpos].set(k_lo, mode="drop"),
+        val=kv.val.at[wpos].set(v, mode="drop"),
+        slot=kv.slot.at[wpos].set(new_slot, mode="drop"),
+        dropped=kv.dropped + (place & ~placed_a & ~placed_b).sum(),
+    )
 
 
 def kv_apply_batch_lanes(kv: KVState, op, k_hi, k_lo, v, valid):

@@ -187,9 +187,7 @@ class Client:
         t0 = time.monotonic()
         deadline = t0 + timeout_s
         if self.sock is None:
-            self.connect(self.connected_to
-                         if getattr(self, "connected_to", None) is not None
-                         else None)
+            self.connect(getattr(self, "connected_to", None))
         # persistent pending list; each loop filters only the HEAD
         # window under the lock (O(batch), so the reader thread is
         # never stalled behind an O(n) scan), and unacked heads are

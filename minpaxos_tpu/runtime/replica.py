@@ -148,6 +148,8 @@ class ReplicaServer:
         self._proto_thread: threading.Thread | None = None
         self._idle = False  # last step produced no work (throttle ticks)
         self._last_step = 0.0
+        self._seen_leader = False  # any PREPARE/ACCEPT/COMMIT from a peer
+        self._boot_pending: float | None = None  # deferred boot election
         # control-plane snapshot: the protocol thread swaps in a fresh
         # plain-Python dict each tick; other threads only ever read it.
         # They must NOT touch self.state — its arrays are donated into
@@ -310,6 +312,9 @@ class ReplicaServer:
                     resp = {"ok": self.fatal is None,
                             "frontier": snap["frontier"],
                             "leader": snap["leader"], "stats": self.stats,
+                            "window_base": snap["window_base"],
+                            "crt_inst": snap.get("crt_inst", -1),
+                            "prepared": snap.get("prepared"),
                             "fatal": self.fatal}
                 elif m == "be_the_leader":
                     self.queue.put((CONTROL, 0, "be_the_leader", None))
@@ -330,13 +335,13 @@ class ReplicaServer:
 
     def _beacon_loop(self) -> None:
         """Reference SendBeacon/ReplyBeacon + EWMA RTT
-        (genericsmr.go:537-551, :429)."""
+        (genericsmr.go:537-551, :429). This thread only ENQUEUES the
+        beacon; the protocol thread writes it — peer writers are
+        single-threaded by contract (transport.py), and a concurrent
+        write racing the protocol thread's flush is silently dropped
+        (append between flush's snapshot and clear)."""
         while not self._stop.is_set():
-            rows = make_batch(MsgKind.BEACON, rid=self.me,
-                              timestamp=np.uint64(cputicks()))
-            for q in range(self.cfg.n_replicas):
-                if q != self.me:
-                    self.transport.send_peer(q, MsgKind.BEACON, rows)
+            self.queue.put((CONTROL, 0, "send_beacon", None))
             time.sleep(0.2)
 
     # ---------------- the protocol loop ----------------
@@ -353,7 +358,7 @@ class ReplicaServer:
                 # so the PREPARE reaches everyone. Mencius has no
                 # leader — every replica proposes into its own slots.
                 self._wait_for_peers()
-                self.queue.put((CONTROL, 0, "be_the_leader", None))
+                self.queue.put((CONTROL, 0, "be_the_leader", "boot"))
             while not self._stop.is_set():
                 self._tick()
         except FatalReplicaError as e:
@@ -385,6 +390,18 @@ class ReplicaServer:
         # cluster from saturating small hosts with no-op device steps.
         timeout = 0.03 if self._idle else self.flags.tick_s
         elect = self._drain(timeout)
+        if (self._boot_pending is not None
+                and time.monotonic() >= self._boot_pending):
+            self._boot_pending = None
+            stale = (self._seen_leader
+                     or self.snapshot["frontier"] >= 0
+                     or self.snapshot["leader"] not in (-1, self.me))
+            if stale:
+                dlog(f"replica {self.me}: skipping stale boot "
+                     f"self-election (leader={self.snapshot['leader']},"
+                     f" frontier={self.snapshot['frontier']})")
+            else:
+                elect = True
         if (self._idle and not elect and self.inbox.fill == 0
                 and time.monotonic() - self._last_step < 0.05):
             return
@@ -416,7 +433,29 @@ class ReplicaServer:
             src_kind, conn_id, kind, rows = item
             if src_kind == CONTROL:
                 if kind == "be_the_leader":
-                    elect = True
+                    # the BOOT self-election is a cold-start convenience
+                    # (bareminpaxos.go:286-290), not an authority claim:
+                    # if this replica's first tick was delayed (a long
+                    # first jit compile on a loaded host) the cluster
+                    # may already have an active leader + committed
+                    # prefix — deposing it with an empty log wedged the
+                    # cluster at the old leader's last catch-up chunk
+                    # (round-5 wedge hunt). Defer the decision half a
+                    # second of ticking (_tick settles it) so traffic
+                    # racing the boot event can land first. Master
+                    # promotions (rows is None) stay unconditional: the
+                    # master knows more than we do.
+                    if rows == "boot":
+                        self._boot_pending = time.monotonic() + 0.5
+                    else:
+                        elect = True
+                elif kind == "send_beacon":
+                    rows = make_batch(MsgKind.BEACON, rid=self.me,
+                                      timestamp=np.uint64(cputicks()))
+                    for q in range(self.cfg.n_replicas):
+                        if q != self.me:
+                            self.transport.send_peer(q, MsgKind.BEACON,
+                                                     rows)
             elif src_kind == CONN_LOST:
                 pass  # peer redial is lazy (dispatch path)
             elif kind == MsgKind.BEACON:
@@ -444,19 +483,29 @@ class ReplicaServer:
                 for c in rows["cmd_id"]:
                     self._pending[(conn_id, int(c))] = MsgKind.READ_REPLY
             else:
+                if src_kind == FROM_PEER and kind in (
+                        MsgKind.PREPARE, MsgKind.ACCEPT, MsgKind.COMMIT,
+                        MsgKind.COMMIT_SHORT):
+                    # sticky: leader-originated traffic exists, so a
+                    # still-queued boot self-election is stale even if
+                    # the snapshot hasn't caught up yet (first drain
+                    # runs before the first device tick)
+                    self._seen_leader = True
                 if src_kind == FROM_CLIENT and kind == MsgKind.PROPOSE:
                     for c in rows["cmd_id"]:
                         self._pending[(conn_id, int(c))] = MsgKind.PROPOSE_REPLY
                     self.stats["proposals"] += len(rows)
-                if (self.protocol == "mencius"
-                        and kind == MsgKind.PREPARE_INST):
-                    # beyond-retention heal: a revived laggard's
-                    # takeover sweep asks about slots we already slid
-                    # out; the device can't answer (out of window) but
-                    # the stable store's mirror can — serve the range
-                    # as COMMIT rows (the mencius counterpart of
-                    # MinPaxos's leader-side _host_catchup)
-                    self._mencius_store_answer(rows)
+                if kind == MsgKind.PREPARE_INST:
+                    # beyond-retention heal, ALL protocols: a sweep
+                    # (mencius takeover, or a re-elected laggard
+                    # leader's phase-1 sweep) asks about slots we
+                    # already slid out; the device can't answer (out of
+                    # window) but the stable store's mirror can — serve
+                    # the range as COMMIT rows. Without this, a leader
+                    # elected with a stale log wedges forever once its
+                    # sweep reaches slots beyond every follower's
+                    # window (round-5 wedge hunt).
+                    self._store_answer_sweep(rows)
                 batches.frame_to_rows(self.inbox, kind, rows, conn_id)
             if self.inbox.room() <= 0:
                 break
@@ -479,13 +528,15 @@ class ReplicaServer:
             val=rec["val"], cmd_id=rec["cmd_id"],
             client_id=rec["client_id"], last_committed=frontier)
 
-    def _mencius_store_answer(self, rows) -> None:
-        """Serve a takeover sweep that reaches below our window from
-        the durable mirror: COMMIT rows for [lowest asked slot,
+    def _store_answer_sweep(self, rows) -> None:
+        """Serve a PREPARE_INST sweep that reaches below our window
+        from the durable mirror: COMMIT rows for [lowest asked slot,
         committed prefix], chunked by catchup_rows. Not capped at the
         asked range — the laggard's crt_inst advances from the commits
         it applies, which is what lets its next sweep reach further
-        (its own view of the log tip is stale by exactly the gap)."""
+        (its own view of the log tip is stale by exactly the gap).
+        Serves mencius takeover sweeps and minpaxos/classic new-leader
+        phase-1 sweeps alike."""
         base = self.snapshot["window_base"]
         lo = int(rows["inst"].min())
         if lo >= base:
@@ -549,9 +600,17 @@ class ReplicaServer:
                 f"small for the live key space); failing stop")
             raise FatalReplicaError(self.fatal)
         mencius = self.protocol == "mencius"
+        frontier = int(np.asarray(self.state.committed_upto))
+        if frontier < self.snapshot["frontier"]:
+            # the commit frontier is monotonic by construction; going
+            # backward means device state was rebuilt/corrupted — make
+            # that loudly visible (it presents as a silent wedge)
+            dlog(f"replica {self.me}: FRONTIER WENT BACKWARD "
+                 f"{self.snapshot['frontier']} -> {frontier}")
         self.snapshot = {
-            "frontier": int(np.asarray(self.state.committed_upto)),
+            "frontier": frontier,
             "window_base": int(np.asarray(self.state.window_base)),
+            "crt_inst": int(np.asarray(self.state.crt_inst)),
             # mencius is leaderless: leader=-1 hints clients any
             # replica serves; prepared=True keeps the re-prepare
             # wedge-guard inert

@@ -1,0 +1,132 @@
+"""Per-kernel step profiler: where does a protocol tick's time go?
+
+Times the jitted protocol steps (MinPaxos / Mencius) and the KV
+sub-kernels standalone at deployment shapes, on whatever backend JAX
+resolves (pin with JAX_PLATFORMS). This is the measurement tool behind
+the round-5 step optimization work (VERDICT round 4 items 6-7): it
+separates device compute from dispatch overhead and isolates the KV
+claim loop's capacity scaling.
+
+Run: JAX_PLATFORMS=cpu python tools/profile_step.py [--window 4096]
+Prints one labeled ms/op line per case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from minpaxos_tpu.models.mencius import init_mencius, mencius_step
+from minpaxos_tpu.models.minpaxos import (
+    MinPaxosConfig,
+    MsgBatch,
+    init_replica,
+    replica_step,
+)
+from minpaxos_tpu.ops import kvstore
+from minpaxos_tpu.wire.messages import MsgKind, Op
+
+
+def _time(fn, iters: int = 20) -> float:
+    """Median ms over ``iters`` calls (after one warmup)."""
+    fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def propose_inbox(cfg: MinPaxosConfig, n_prop: int, to_leader: bool) -> MsgBatch:
+    m = cfg.inbox
+    cols = {c: np.zeros(m, np.int32) for c in MsgBatch._fields}
+    cols["kind"][:n_prop] = int(MsgKind.PROPOSE)
+    cols["src"][:n_prop] = -1
+    cols["op"][:n_prop] = int(Op.PUT)
+    cols["key_lo"][:n_prop] = np.arange(n_prop, dtype=np.int32)
+    cols["val_lo"][:n_prop] = np.arange(n_prop, dtype=np.int32) + 7
+    cols["cmd_id"][:n_prop] = np.arange(n_prop, dtype=np.int32)
+    cols["client_id"][:n_prop] = 5
+    return MsgBatch(**{k: jnp.asarray(v) for k, v in cols.items()})
+
+
+def bench_step(name, step, cfg, state, inbox, iters=20) -> None:
+    # thread the state through (the steps donate their state argument,
+    # so the input buffers are consumed by each call); copy first so
+    # init-time aliased zero buffers aren't donated twice
+    holder = [jax.tree.map(jnp.copy, state)]
+
+    def once():
+        st2, out, ex = step(cfg, holder[0], inbox)
+        jax.block_until_ready(st2)
+        holder[0] = st2
+
+    ms = _time(once, iters)
+    print(f"{name:44s} {ms:8.2f} ms/step")
+
+
+def bench_kv(cfg_label: str, cap_pow2: int, b: int, iters=20) -> None:
+    kv = kvstore.kv_init(cap_pow2)
+    rng = np.random.default_rng(0)
+    op = jnp.asarray(np.full(b, int(Op.PUT), np.int32))
+    k_hi = jnp.asarray(np.zeros(b, np.int32))
+    k_lo = jnp.asarray(rng.integers(0, 100000, b).astype(np.int32))
+    v = jnp.asarray(np.ones((b, kvstore.VAL_LANES), np.int32))
+    valid = jnp.asarray(np.ones(b, bool))
+
+    apply_j = jax.jit(kvstore.kv_apply_batch_lanes)
+
+    def once():
+        kv2, out, found = apply_j(kv, op, k_hi, k_lo, v, valid)
+        jax.block_until_ready(kv2)
+
+    ms = _time(once, iters)
+    print(f"kv_apply_batch  C=2^{cap_pow2:<2d} B={b:<5d} {cfg_label:12s}"
+          f" {ms:8.2f} ms/call")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--window", type=int, default=4096)
+    ap.add_argument("--inbox", type=int, default=2048)
+    ap.add_argument("--props", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    print(f"backend: {jax.devices()[0].platform}", file=sys.stderr)
+
+    for kvp in (16, 20):
+        cfg = MinPaxosConfig(n_replicas=3, window=args.window,
+                             inbox=args.inbox, exec_batch=args.window,
+                             kv_pow2=kvp)
+        st_m = init_mencius(cfg, 0)
+        st_p = init_replica(cfg, 0)
+        empty = MsgBatch.empty(cfg.inbox)
+        prop = propose_inbox(cfg, args.props, to_leader=True)
+        bench_step(f"mencius idle   W={args.window} kv=2^{kvp}",
+                   mencius_step, cfg, st_m, empty, args.iters)
+        bench_step(f"mencius {args.props}prop W={args.window} kv=2^{kvp}",
+                   mencius_step, cfg, st_m, prop, args.iters)
+        bench_step(f"minpaxos idle  W={args.window} kv=2^{kvp}",
+                   replica_step, cfg, st_p, empty, args.iters)
+        bench_step(f"minpaxos {args.props}prop W={args.window} kv=2^{kvp}",
+                   replica_step, cfg, st_p, prop, args.iters)
+
+    for cap in (16, 20):
+        for b in (512, 2048):
+            bench_kv("", cap, b, args.iters)
+
+
+if __name__ == "__main__":
+    main()
