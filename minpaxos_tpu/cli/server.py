@@ -55,6 +55,16 @@ def main(argv=None) -> None:
                    help="resident log window slots")
     p.add_argument("-inbox", type=int, default=4096,
                    help="message rows per protocol tick")
+    p.add_argument("-execbatch", type=int, default=0,
+                   help="max slots executed per tick (0 = inbox size);"
+                        " smaller cuts fixed per-tick exec-pipeline"
+                        " cost, at the price of draining large commit"
+                        " backlogs over more ticks")
+    p.add_argument("-gossipticks", type=int, default=4,
+                   help="frontier-gossip cadence in ticks (1 ="
+                        " immediate); >1 suppresses the per-commit"
+                        " wakeup cascade on small hosts at the cost of"
+                        " idle followers executing a few ticks late")
     p.add_argument("-storedir", default=".",
                    help="stable store directory")
     p.add_argument("-platform", default="cpu",
@@ -89,15 +99,16 @@ def main(argv=None) -> None:
     # client's default -sr key range (30000) — the runtime FAIL-STOPS
     # on table saturation rather than silently dropping acknowledged
     # writes (the reference's Go map just grows, state.go:33-36), so
-    # capacity and key space must be sized together. NOTE the
-    # per-tick KV cost scales with table CAPACITY (the parallel claim
-    # loop materializes a capacity-length array per probe iteration,
-    # ops/kvstore.py), so "just make it huge" measurably slows every
-    # tick — raise -kvpow2 deliberately, with the workload in mind.
+    # capacity and key space must be sized together: the bucketized
+    # two-choice table (ops/kvstore.py) keeps per-tick cost O(batch),
+    # but the table's residual per-step traffic still grows with
+    # capacity — raise -kvpow2 deliberately, with the workload in
+    # mind (keep load under ~0.5 for comfortable two-choice placement)
     cfg = MinPaxosConfig(
         n_replicas=len(nodes), window=args.window, inbox=args.inbox,
-        exec_batch=args.inbox, kv_pow2=args.kvpow2,
+        exec_batch=args.execbatch or args.inbox, kv_pow2=args.kvpow2,
         catchup_rows=256, recovery_rows=256,
+        gossip_ticks=args.gossipticks,
         explicit_commit=args.classic and not args.mencius)
     prof = cProfile.Profile() if args.cpuprofile else None
     flags = RuntimeFlags(dreply=args.dreply,

@@ -84,6 +84,14 @@ class MinPaxosConfig(NamedTuple):
     # Size retention to cover the longest expected outage.
     slide_window: bool = True
     retention: int = -1  # executed slots retained per replica; -1 = window//2
+    # Frontier-gossip cadence in ticks. 1 = gossip immediately on every
+    # advance (right for the lock-step pod composition, where rounds
+    # are synchronous and a gossip row costs nothing extra). The
+    # event-driven TCP runtime sets ~4: there every gossip row WAKES
+    # idle peers, and per-commit gossip cascaded each serial op into
+    # ~4 extra process wakeups that serialized into commit latency on
+    # small hosts (round-5 trace; cli/server.py -gossipticks).
+    gossip_ticks: int = 1
     # Protocol selector: False = MinPaxos (global ballot, commits learned
     # from the LastCommitted piggyback on Accepts — bareminpaxos.go hot
     # path, SURVEY.md 3.2); True = classic per-instance Multi-Paxos
@@ -203,6 +211,16 @@ class ReplicaState(NamedTuple):
     # full CatchUpLog shipping (bareminpaxos.go:488-513, :912-966)
     pvotes: jnp.ndarray  # u16[S]: bit r = replica r answered phase 1
     rec_cursor: jnp.ndarray  # i32 next slot the leader's sweep requests
+    # log tip at the moment this leader's prepare quorum completed:
+    # slots at/above it were created by THIS tenure's own proposals and
+    # never need phase-1 discovery — without the bound, every new
+    # proposal re-armed the sweep for its own in-flight slot and each
+    # serial op shipped pointless PREPARE_INST broadcasts (round-5
+    # trace). Tracks crt_inst while unprepared (so election-time
+    # discovery keeps extending it), freezes once prepared; the
+    # stalled-frontier rescan ignores it (full-range safety net).
+    tenure_start: jnp.ndarray  # i32
+    gossip_upto: jnp.ndarray  # i32 frontier as of the last gossip row
     kv: KVState
 
     @property
@@ -244,6 +262,8 @@ def init_replica(cfg: MinPaxosConfig, me: int) -> ReplicaState:
         stall_ticks=jnp.int32(0),
         pvotes=jnp.zeros(s, dtype=jnp.uint16),
         rec_cursor=jnp.int32(0),
+        tenure_start=jnp.int32(0),
+        gossip_upto=jnp.int32(-1),
         kv=kv_init(cfg.kv_pow2),
     )
 
@@ -273,6 +293,8 @@ def become_leader(cfg: MinPaxosConfig, state: ReplicaState) -> tuple[ReplicaStat
         # the per-instance discovery sweep at our commit frontier
         pvotes=jnp.zeros(cfg.window, dtype=jnp.uint16),
         rec_cursor=state.committed_upto + 1,
+        # fresh tenure: re-track the tip until the new prepare quorum
+        tenure_start=state.crt_inst + 0,
     )
     out = MsgBatch.empty(1)
     out = out._replace(
@@ -612,6 +634,14 @@ def replica_step_impl(
             state.crt_inst, jnp.max(jnp.where(pr_ok, inbox.inst, -1))),
     )
     state = state._replace(
+        # track the discovered log tip through phase 1, freeze at the
+        # prepare quorum: slots above this are our own tenure's
+        # proposals (see tenure_start field note; ordered before the
+        # prepared update so the quorum-forming step still captures
+        # this step's discovery)
+        tenure_start=jnp.where(state.prepared, state.tenure_start,
+                               state.crt_inst))
+    state = state._replace(
         prepared=state.prepared
         | (state.is_leader & (state.prepare_oks.sum() >= majority)),
     )
@@ -739,14 +769,42 @@ def replica_step_impl(
     # the Accept piggyback inert, an idle leader's followers would
     # otherwise never learn the last commits (the reference instead
     # bcasts per-instance Commits inline, paxos.go:661).
+    # non-classic gossip runs on a 4-tick cadence with a watermark
+    # (gossip_upto): per-commit gossip made every serial op cascade
+    # into ~4 extra ticks across the cluster (leader commit ->
+    # COMMIT_SHORT wakes both followers -> their exec + frontier
+    # reports -> one more leader tick), which on a single-core host
+    # directly serialized into commit latency (round-5 trace). The
+    # watermark keeps it edge-triggered — an advance just before an
+    # idle stretch still gossips on the next cadence tick. Accept
+    # piggybacking carries the frontier under load anyway; the cadence
+    # only delays IDLE followers' exec by <=4 ticks.
+    if cfg.gossip_ticks > 1:
+        cadence = (state.tick % cfg.gossip_ticks) == 0
+    else:
+        cadence = jnp.asarray(True)
+    behind = state.committed_upto > state.gossip_upto
+    # a follower reports its frontier whenever this step processed
+    # inbound consensus traffic (got_committy): the report rides the
+    # reply frame that traffic generates anyway, and the lossy
+    # pod-mode fabric (fixed-row inboxes drop overflow) depends on
+    # prompt reports to aim the leader's catch-up — gating these to
+    # the cadence starved healing and wedged saturated fused runs. A
+    # QUIET follower reports only on the cadence: that standalone
+    # report is exactly the wakeup cascade the cadence suppresses
+    # (an always-eager variant fed back into a permanent tick storm
+    # under closed-loop serial load — round-5 trace).
     if cfg.explicit_commit:
         lead_adv = state.is_leader & state.prepared & (
             state.committed_upto >= 0)
     else:
-        lead_adv = state.is_leader & advanced
+        lead_adv = state.is_leader & state.prepared & cadence & behind
     got_committy = (is_accept | is_commit | is_cshort | is_pir).any()
     fol_report = (~state.is_leader) & (state.leader_id >= 0) & (
-        advanced | got_committy)
+        got_committy | (cadence & behind))
+    state = state._replace(
+        gossip_upto=jnp.where(lead_adv | fol_report, state.committed_upto,
+                              state.gossip_upto))
     fb = MsgBatch.empty(1)
     fb = fb._replace(
         kind=jnp.where(lead_adv, int(MsgKind.COMMIT_SHORT),
@@ -814,7 +872,13 @@ def replica_step_impl(
     # no-ops — the classic new-leader gap fill; the reference's
     # equivalent half-finished path is flagged in SURVEY.md section
     # 7.4.
-    do_rt = state.is_leader & state.prepared & (state.stall_ticks >= 1)
+    # >= 4, not >= 1: a leader awaiting acks keeps ticking at tick_s
+    # (it is not idle), so the stall counter reaches 2-3 within one
+    # normal ack round-trip and a low threshold rebroadcast every
+    # in-flight accept once per op — pure duplicate traffic that the
+    # followers then re-ack (round-5 trace). Genuinely lost accepts
+    # still retry within ~4 ticks (milliseconds).
+    do_rt = state.is_leader & state.prepared & (state.stall_ticks >= 4)
     rt_slots = state.committed_upto + 1 + jnp.arange(K, dtype=jnp.int32)
     rt_rel = rt_slots - state.window_base
     rt_rel_safe = jnp.clip(rt_rel, 0, S - 1)
@@ -886,15 +950,21 @@ def replica_step_impl(
     # been lost).
     K2 = cfg.recovery_rows
     sweep_on = state.is_leader & state.prepared
-    done = state.rec_cursor >= state.crt_inst
+    # the steady-state sweep stops at tenure_start: slots at/above it
+    # are this tenure's own proposals and need no discovery (see the
+    # tenure_start field note). The stalled-frontier rescan lifts the
+    # bound — if the frontier truly stalls, sweep everything.
+    limit = jnp.minimum(state.crt_inst, state.tenure_start)
+    done = state.rec_cursor >= limit
     rescan = sweep_on & done & in_flight & (
         state.stall_ticks >= cfg.noop_delay)
+    eff_limit = jnp.where(rescan, state.crt_inst, limit)
     cursor = jnp.where(rescan, state.committed_upto + 1, state.rec_cursor)
     cursor = jnp.maximum(cursor, state.committed_upto + 1)
     pi_slots = cursor + jnp.arange(K2, dtype=jnp.int32)
     pi_rel = pi_slots - state.window_base
     pi_rel_safe = jnp.clip(pi_rel, 0, S - 1)
-    pi_ok = sweep_on & (pi_slots < state.crt_inst) & (pi_rel >= 0) & (
+    pi_ok = sweep_on & (pi_slots < eff_limit) & (pi_rel >= 0) & (
         pi_rel < S)
     pi = MsgBatch.empty(K2)._replace(
         kind=jnp.where(pi_ok, int(MsgKind.PREPARE_INST), 0).astype(jnp.int32),
@@ -909,7 +979,7 @@ def replica_step_impl(
         pvotes=state.pvotes | jnp.zeros(S, jnp.uint16).at[
             jnp.where(pi_ok, pi_rel, S)].set(me_bit, mode="drop"),
         rec_cursor=jnp.where(
-            sweep_on, jnp.minimum(cursor + K2, state.crt_inst), cursor),
+            sweep_on, jnp.minimum(cursor + K2, eff_limit), cursor),
     )
 
     out = _concat_rows(_concat_rows(_concat_rows(_concat_rows(out, pi), fb), cu), rt)
@@ -930,15 +1000,22 @@ def replica_step_impl(
     evalid = jnp.arange(E) < n_exec
     rel_e_safe = jnp.clip(rel_e, 0, S - 1)
     op_e = jnp.where(evalid, state.op[rel_e_safe].astype(jnp.int32), 0)
-    kv, o_hi, o_lo, o_found = kv_apply_batch(
-        state.kv,
-        op_e,
-        state.key_hi[rel_e_safe],
-        state.key_lo[rel_e_safe],
-        state.val_hi[rel_e_safe],
-        state.val_lo[rel_e_safe],
-        evalid,
-    )
+
+    # the sort/lookup/insert pipeline is the step's most expensive
+    # fixed block; steps with nothing to execute (pure propose/accept
+    # traffic — 2 of the ~3 steps on a serial op's path) skip it
+    # entirely via cond instead of running it over all-invalid rows
+    def _exec_kv(kv):
+        return kv_apply_batch(
+            kv, op_e, state.key_hi[rel_e_safe], state.key_lo[rel_e_safe],
+            state.val_hi[rel_e_safe], state.val_lo[rel_e_safe], evalid)
+
+    def _no_exec(kv):
+        z = jnp.zeros(E, jnp.int32)
+        return kv, z, z, jnp.zeros(E, bool)
+
+    kv, o_hi, o_lo, o_found = jax.lax.cond(
+        n_exec > 0, _exec_kv, _no_exec, state.kv)
     state = state._replace(
         kv=kv,
         executed_upto=state.executed_upto + n_exec,

@@ -51,7 +51,7 @@ from minpaxos_tpu.runtime.transport import (
     Transport,
 )
 from minpaxos_tpu.utils.clock import cputicks, monotonic_ns
-from minpaxos_tpu.utils.dlog import dlog
+from minpaxos_tpu.utils.dlog import DLOG, dlog
 from minpaxos_tpu.utils.netutil import CONTROL_OFFSET
 from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
 
@@ -81,6 +81,13 @@ class RuntimeFlags:
     thrifty: bool = False  # -thrifty: send accepts to a quorum only
     beacon: bool = False   # -beacon: RTT beacons -> preferred quorum
     tick_s: float = 0.002  # protocol tick (reference clock: 5ms)
+    # idle poll interval: a quiet replica wakes this often to drive
+    # retries/stall detection. Message arrival always wakes it
+    # immediately (queue.get), so this only prices background wakeups
+    # — on a single-core host every idle tick preempts whoever is
+    # doing real work, which directly inflates serial commit latency
+    # (round-5 measurement: ~2x per-tick wall vs isolated).
+    idle_s: float = 0.05
     store_dir: str = "."
     # -cpuprofile: a cProfile.Profile the PROTOCOL THREAD enables on
     # start (cProfile is per-thread; enabling it on the main thread —
@@ -388,7 +395,7 @@ class ReplicaServer:
         # tick_s — incoming messages still trigger an immediate step
         # via the queue wakeup. Keeps an idle N-replica in-process
         # cluster from saturating small hosts with no-op device steps.
-        timeout = 0.03 if self._idle else self.flags.tick_s
+        timeout = self.flags.idle_s if self._idle else self.flags.tick_s
         elect = self._drain(timeout)
         if (self._boot_pending is not None
                 and time.monotonic() >= self._boot_pending):
@@ -492,9 +499,33 @@ class ReplicaServer:
                     # runs before the first device tick)
                     self._seen_leader = True
                 if src_kind == FROM_CLIENT and kind == MsgKind.PROPOSE:
+                    # drop same-connection re-sends of still-pending
+                    # commands: the client's retry driver re-proposes
+                    # unacked ids after a timeout, and admitting the
+                    # re-send would allocate a SECOND log slot (and a
+                    # second reply) for a command that is merely slow —
+                    # under load that amplifies into a retry storm
+                    # (each re-proposal adds slots, slowing commits,
+                    # causing more timeouts; Mencius's blocking
+                    # frontier made this a death spiral, round 5). A
+                    # failed-over client arrives on a NEW connection
+                    # and is admitted as before.
+                    fresh = np.fromiter(
+                        ((conn_id, int(c)) not in self._pending
+                         for c in rows["cmd_id"]), bool, len(rows))
+                    if not fresh.all():
+                        rows = rows[fresh]
+                    # truncate to inbox room BEFORE registering: a row
+                    # registered but dropped by ColumnBuffer overflow
+                    # would make the dedup blackhole its retries (the
+                    # reply that pops the pending entry never comes)
+                    rows = rows[:max(self.inbox.room(), 0)]
                     for c in rows["cmd_id"]:
                         self._pending[(conn_id, int(c))] = MsgKind.PROPOSE_REPLY
                     self.stats["proposals"] += len(rows)
+                    if DLOG:
+                        dlog(f"replica {self.me}: drain PROPOSE "
+                             f"n={len(rows)}")
                 if kind == MsgKind.PREPARE_INST:
                     # beyond-retention heal, ALL protocols: a sweep
                     # (mencius takeover, or a re-elected laggard
@@ -567,12 +598,18 @@ class ReplicaServer:
 
     def _device_tick(self, buf: batches.ColumnBuffer,
                      persist: bool = True, dispatch: bool = True) -> None:
+        if DLOG and buf.fill:
+            dlog(f"replica {self.me}: tick start fill={buf.fill}")
+        t0 = time.perf_counter() if DLOG else 0.0
         cols, n_rows = buf.drain()
         inbox = MsgBatch(**{c: np.asarray(cols[c]) for c in batches.COLS})
         self.state, outbox, execr = self.step(self.state, inbox)
         out_cols = {c: np.asarray(getattr(outbox.msgs, c))
                     for c in batches.COLS}
         dst = np.asarray(outbox.dst)
+        if DLOG and n_rows:
+            dlog(f"replica {self.me}: step+convert "
+                 f"{(time.perf_counter() - t0) * 1e3:.2f}ms")
         if persist:
             # always maintained (in-memory mirror feeds beyond-window
             # catch-up); -durable additionally fsyncs before replies
@@ -752,6 +789,9 @@ class ReplicaServer:
         live = kinds != 0
         if not live.any():
             return
+        if DLOG:
+            dlog(f"replica {self.me}: dispatch "
+                 f"{np.bincount(kinds[live]).nonzero()[0].tolist()}")
         thrifty_q = self._quorum_targets() if self.flags.thrifty else None
         for q in range(self.cfg.n_replicas):
             if q == self.me:
@@ -791,6 +831,8 @@ class ReplicaServer:
         self.stats["committed"] = int(np.asarray(self.state.committed_upto)) + 1
         if n == 0 or not self.flags.dreply:
             return
+        if DLOG:
+            dlog(f"replica {self.me}: reply n={n}")
         cids = np.asarray(execr.client_id)[:n]
         cmds = np.asarray(execr.cmd_id)[:n]
         vals = join_i64(np.asarray(execr.val_hi)[:n],

@@ -1,0 +1,105 @@
+"""Unit tests for the shared ack-run compression kernels
+(ops/ackruns.py): emission/consumption must stay in lockstep, for both
+the MinPaxos consecutive-slot stride and Mencius's owner stride R."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minpaxos_tpu.ops.ackruns import compress_ack_runs, range_vote_coverage
+
+
+def _naive_coverage(valid, src, inst, count, wb, window, r, stride):
+    cov = np.zeros((window, r), bool)
+    for v, sr, i0, c in zip(valid, src, inst, count):
+        if not v:
+            continue
+        for j in range(max(int(c), 1)):
+            rel = i0 + j * stride - wb
+            if 0 <= rel < window:
+                cov[rel, sr] = True
+    return cov
+
+
+@pytest.mark.parametrize("stride", [1, 3, 5])
+def test_compress_runs_form_at_protocol_stride(stride):
+    # one sender acks 6 slots spaced `stride` apart: ONE run of 6
+    m = 8
+    is_acc = jnp.asarray([True] * 6 + [False] * 2)
+    src = jnp.full(m, 1, jnp.int32)
+    inst = jnp.asarray([10 + stride * i for i in range(6)] + [0, 0],
+                       jnp.int32)
+    ok = jnp.asarray([True] * 6 + [False] * 2)
+    start, length = compress_ack_runs(is_acc, src, inst, ok,
+                                      stride=stride)
+    assert np.asarray(start)[:6].tolist() == [True] + [False] * 5
+    assert int(np.asarray(length)[0]) == 6
+
+
+def test_compress_breaks_on_wrong_stride():
+    # consecutive insts under stride 3 never form runs
+    is_acc = jnp.ones(4, bool)
+    src = jnp.zeros(4, jnp.int32)
+    inst = jnp.asarray([7, 8, 9, 10], jnp.int32)
+    ok = jnp.ones(4, bool)
+    start, length = compress_ack_runs(is_acc, src, inst, ok, stride=3)
+    assert np.asarray(start).all()
+    assert np.asarray(length).tolist() == [1, 1, 1, 1]
+
+
+def test_compress_breaks_on_sender_ok_ballot():
+    is_acc = jnp.ones(6, bool)
+    src = jnp.asarray([0, 0, 1, 1, 1, 1], jnp.int32)
+    inst = jnp.asarray([0, 3, 6, 9, 12, 15], jnp.int32)
+    ok = jnp.asarray([True, True, True, True, False, False])
+    bal = jnp.asarray([5, 5, 5, 5, 5, 6], jnp.int32)
+    start, length = compress_ack_runs(is_acc, src, inst, ok,
+                                      ballot=bal, stride=3)
+    # runs: [0,3] by 0; [6,9] by 1 ok; [12] nack bal5; [15] nack bal6
+    assert np.asarray(start).tolist() == [True, False, True, False,
+                                          True, True]
+    assert np.asarray(length).tolist() == [2, 2, 2, 2, 1, 1]
+
+
+@pytest.mark.parametrize("stride", [1, 3])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_range_coverage_matches_naive(stride, seed):
+    rng = np.random.default_rng(seed)
+    window, r, m = 64, 3, 40
+    wb = int(rng.integers(0, 1000))
+    valid = rng.random(m) < 0.8
+    src = rng.integers(0, r, m)
+    # starts straddling both window edges, ranges of varied length
+    inst = wb + rng.integers(-30, window + 10, m)
+    count = rng.integers(0, 12, m)  # 0 = pre-compression padding row
+    got = np.asarray(range_vote_coverage(
+        jnp.asarray(valid), jnp.asarray(src, jnp.int32),
+        jnp.asarray(inst, jnp.int32), jnp.asarray(count, jnp.int32),
+        jnp.int32(wb), window, r, stride=stride))
+    want = _naive_coverage(valid, src, inst, count, wb, window, r,
+                           stride)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_emit_consume_lockstep_stride_r():
+    """End-to-end: rows an owner would ack (its foreign-owner accepts,
+    stride R) compress to one row whose (inst, count) reproduces the
+    original coverage exactly at the driving owner."""
+    r, window, wb = 3, 32, 99
+    # owner 1's accepts for its slots 100, 103, ..., 118 (7 slots)
+    insts = np.array([100 + 3 * i for i in range(7)], np.int32)
+    m = len(insts)
+    start, length = compress_ack_runs(
+        jnp.ones(m, bool), jnp.full(m, 2, jnp.int32),
+        jnp.asarray(insts), jnp.ones(m, bool), stride=3)
+    # emitter publishes (inst, count) on start rows only
+    valid = np.asarray(start)
+    count = np.asarray(length)
+    cov = np.asarray(range_vote_coverage(
+        jnp.asarray(valid), jnp.full(m, 2, jnp.int32),
+        jnp.asarray(insts), jnp.asarray(count, jnp.int32),
+        jnp.int32(wb), window, r, stride=3))
+    want = _naive_coverage(np.ones(m, bool), np.full(m, 2),
+                           insts, np.ones(m, np.int32), wb, window, r,
+                           stride=3)
+    np.testing.assert_array_equal(cov, want)
