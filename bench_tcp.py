@@ -54,13 +54,19 @@ REPO = pathlib.Path(__file__).resolve().parent
 
 SERVER_SHAPE = ["-window", "2048", "-inbox", "1024", "-kvpow2", "18",
                 "-execbatch", "128"]
+# Mencius fills ~2x the slots per client op (idle owners cede SKIPs
+# that are committed no-op rows too) and serves three concurrent
+# proposers, so it wants the wider window/inbox and a full-size exec
+# drain — the tight minpaxos shape starved it (325 vs ~1.3k ops/s)
+MENCIUS_SHAPE = ["-window", "4096", "-inbox", "2048", "-kvpow2", "18",
+                 "-execbatch", "512"]
 
 
 def _progress(msg: str) -> None:
     print(f"[bench_tcp] {msg}", file=sys.stderr, flush=True)
 
 
-def _boot(proto_flag: str, env, tmp) -> tuple[list, int]:
+def _boot(proto_flag: str, env, tmp, shape) -> tuple[list, int]:
     mport = free_ports(1)[0]
     dports = free_ports(3, sibling_offset=CONTROL_OFFSET)
     procs = [subprocess.Popen(
@@ -73,7 +79,7 @@ def _boot(proto_flag: str, env, tmp) -> tuple[list, int]:
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "minpaxos_tpu.cli.server",
              proto_flag, "-durable", "-port", str(p),
-             "-mport", str(mport), *SERVER_SHAPE,
+             "-mport", str(mport), *shape,
              "-storedir", str(tmp)],
             env=env, cwd=tmp, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL))
@@ -129,7 +135,8 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
     tmp.mkdir(exist_ok=True)
     for f in tmp.glob("stable-store-replica*"):
         f.unlink()
-    procs, mport = _boot(proto_flag, env, tmp)
+    shape = MENCIUS_SHAPE if multi_rr else SERVER_SHAPE
+    procs, mport = _boot(proto_flag, env, tmp, shape)
     maddr = ("127.0.0.1", mport)
     try:
         from minpaxos_tpu.runtime.client import (
@@ -201,7 +208,7 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
             "serial_p99_ms": round(lats[int(len(lats) * 0.99)], 3)
             if lats else None,
             "n_serial": len(lats),
-            "server_shape": " ".join(SERVER_SHAPE),
+            "server_shape": " ".join(shape),
             "reference_shape": ref_shape,
         }
     finally:

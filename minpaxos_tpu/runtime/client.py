@@ -203,15 +203,26 @@ class Client:
                 pending = tail
                 continue
             w = np.asarray(head)
+            broken = False
             try:
                 self.propose(w, ops[w], keys[w], vals[w])
                 ok = self.wait(w, timeout_s=3.0)
             except OSError:
-                ok = False
+                ok, broken = False, True
             if ok:
                 pending = tail
             else:
-                self._failover()
+                # only fail over when the connection died or NOTHING
+                # acked — a slow-but-live cluster keeps the SAME
+                # connection, so the server's same-connection dedup
+                # absorbs the re-proposal instead of a fresh conn_id
+                # allocating duplicate slots (the retry-storm
+                # amplifier; reconnecting on every timeout made the
+                # dedup unreachable)
+                with self._lock:
+                    progressed = any(c in self.replies for c in head)
+                if broken or not progressed:
+                    self._failover()
                 pending = head + tail
         with self._lock:
             done = sum(1 for c in idx if int(c) in self.replies)

@@ -410,7 +410,7 @@ class ReplicaServer:
             else:
                 elect = True
         if (self._idle and not elect and self.inbox.fill == 0
-                and time.monotonic() - self._last_step < 0.05):
+                and time.monotonic() - self._last_step < self.flags.idle_s):
             return
         if elect:
             self._become_leader()

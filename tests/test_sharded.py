@@ -140,3 +140,25 @@ def test_multihost_glue_single_process_degenerate():
     sc.run_fused(4, 16)
     tot, _, _ = sc.committed()
     assert tot > 0
+
+
+def test_fused_substeps_cut_commit_rounds():
+    """substeps=2 delivers message traffic twice per fused round, so a
+    proposal's commit lands ~one ROUND earlier (commit-on-quorum
+    within the round the quorum forms — VERDICT round-4 item 5). Same
+    commits, fewer rounds-to-commit; the throughput/latency tradeoff
+    is measured by bench.py, correctness pinned here."""
+    def first_round_reaching(substeps):
+        sc = ShardedCluster(SMALL, 2)
+        sc.elect(0)
+        uptos, _ = sc.run_fused(6, 16, substeps=substeps)
+        want = 15  # all 16 round-0 proposals committed
+        for r in range(6):
+            if uptos[r].min() >= want:
+                return r, uptos
+        return 99, uptos
+
+    r1, u1 = first_round_reaching(1)
+    r2, u2 = first_round_reaching(2)
+    assert r1 < 99 and r2 < 99, (u1, u2)
+    assert r2 < r1, (r1, r2, u1[:, 0], u2[:, 0])
