@@ -121,8 +121,19 @@ def main(argv=None) -> None:
                    help="fast mode: send to ALL replicas, first reply "
                         "wins (reference client.go -f; paxos family "
                         "only)")
+    p.add_argument("-barOne", dest="bar_one", action="store_true",
+                   help="send to all replicas except the last "
+                        "(clienttot/client.go:31; implies -e)")
+    p.add_argument("-waitLess", dest="wait_less", action="store_true",
+                   help="wait for all but one partition to finish "
+                        "(clienttot/client.go:32; implies -e)")
     p.add_argument("-timeout", type=float, default=60.0)
     args = p.parse_args(argv)
+    if args.bar_one or args.wait_less:
+        if args.fast:
+            p.error("-barOne/-waitLess are round-robin knobs; "
+                    "they conflict with -f")
+        args.rr = True  # reference: noLeader multi-target send path
 
     from minpaxos_tpu.runtime.client import (
         Client,
@@ -135,7 +146,9 @@ def main(argv=None) -> None:
         if args.lat or args.ol:
             p.error("-e/-f apply to the closed-loop mode only")
         multi = MultiClient((args.maddr, args.mport), check=args.check,
-                            mode="rr" if args.rr else "fast")
+                            mode="rr" if args.rr else "fast",
+                            bar_one=args.bar_one,
+                            wait_less=args.wait_less)
     cli = Client((args.maddr, args.mport), check=args.check)
 
     total_acked = 0

@@ -1,0 +1,57 @@
+"""Command-conflict predicates as first-class vectorized ops.
+
+Counterpart of reference src/state/state.go:53-71: two commands
+conflict iff they touch the same key and at least one writes
+(``Conflict``); two batches conflict iff any cross-pair does
+(``ConflictBatch``). The reference exposes these for its EPaxos-style
+dependency tracking; here they are the standalone form of the
+key-overlap logic the Mencius kernel fuses into its out-of-order
+execution scan (models/mencius.py step 11 — that fused form stays,
+since a segmented scan over the sorted window beats pairwise work
+inside the step; this module is the composable API for new protocols).
+
+All functions are jittable, fixed-shape, and mask-aware (``valid``
+rows), so they can sit inside a kernel or be called standalone.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from minpaxos_tpu.wire.messages import Op
+
+
+def _is_write(op: jnp.ndarray) -> jnp.ndarray:
+    # PUT and DELETE mutate; GET/RLOCK/WLOCK/NONE do not (the
+    # reference's Conflict only names PUT because its DELETE support
+    # is vestigial — state.go:86-103 executes it, :53-59 ignores it;
+    # counting DELETE is the safe superset)
+    return (op == int(Op.PUT)) | (op == int(Op.DELETE))
+
+
+def conflict(op_a, khi_a, klo_a, op_b, khi_b, klo_b) -> jnp.ndarray:
+    """Elementwise Conflict (state.go:53-60): same key and at least
+    one side writes. Broadcasts like jnp operators, so callers can
+    pairwise-compare via standard [B1, 1] x [1, B2] shaping."""
+    same = (khi_a == khi_b) & (klo_a == klo_b)
+    return same & (_is_write(op_a) | _is_write(op_b))
+
+
+def conflict_batch(op_a, khi_a, klo_a, op_b, khi_b, klo_b,
+                   valid_a=None, valid_b=None) -> jnp.ndarray:
+    """ConflictBatch (state.go:62-71): scalar bool — any cross-pair
+    of the two batches conflicts. Pairwise [B1, B2] comparison; both
+    batches are typically kernel-sized (<= inbox rows), so the
+    product stays far below window-scale work."""
+    pair = conflict(op_a[:, None], khi_a[:, None], klo_a[:, None],
+                    op_b[None, :], khi_b[None, :], klo_b[None, :])
+    if valid_a is not None:
+        pair = pair & valid_a[:, None]
+    if valid_b is not None:
+        pair = pair & valid_b[None, :]
+    return pair.any()
+
+
+def is_read(op: jnp.ndarray) -> jnp.ndarray:
+    """IsRead (state.go:73-75)."""
+    return op == int(Op.GET)

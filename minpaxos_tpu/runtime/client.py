@@ -66,6 +66,12 @@ class Client:
         self._got = threading.Condition(self._lock)
         self._reader: threading.Thread | None = None
         self._closed = threading.Event()
+        # permanent shutdown (unlike _closed, never cleared): a
+        # wait_less straggler partition must stop retrying when its
+        # MultiClient is closed, not resurrect the connection via
+        # _failover under a fresh conn_id (which would sidestep the
+        # server's same-connection dedup and duplicate slots)
+        self._done = False
 
     # -- connection management --
 
@@ -194,7 +200,7 @@ class Client:
         # pushed back for retry — an id leaves pending only acked, so
         # commands lost to failover are re-swept without a cursor
         pending = [int(c) for c in idx]
-        while pending and time.monotonic() < deadline:
+        while pending and not self._done and time.monotonic() < deadline:
             with self._lock:
                 head = [c for c in pending[:batch]
                         if c not in self.replies]
@@ -233,6 +239,8 @@ class Client:
         """Leader died or rejected us: prefer its hint, else ask the
         master, else scan replicas for any that accepts TCP
         (clientretry.go:242-251)."""
+        if self._done:
+            return
         candidates: list[int] = []
         if 0 <= self.leader_hint < len(self.nodes):
             candidates.append(self.leader_hint)
@@ -276,12 +284,23 @@ class MultiClient:
     """
 
     def __init__(self, maddr: tuple[str, int], check: bool = False,
-                 mode: str = "rr"):
+                 mode: str = "rr", bar_one: bool = False,
+                 wait_less: bool = False):
+        """``bar_one``: send to all replicas except the LAST (reference
+        clienttot -barOne, clienttot/client.go:31, :76-78 — the
+        excluded replica still learns/executes via the protocol, it
+        just serves no proposals). ``wait_less``: in rr mode, stop
+        waiting once all but one partition finished (clienttot
+        -waitLess, :32, :191-199 — tolerate one straggler replica's
+        batch; its partition keeps draining in the background)."""
         assert mode in ("rr", "fast")
         self.mode = mode
+        self.wait_less = wait_less
         self.nodes = get_replica_list(maddr)
         self.clients: list[Client] = []
-        for rid in range(len(self.nodes)):
+        n_targets = len(self.nodes) - 1 if bar_one else len(self.nodes)
+        assert n_targets >= 1, "-barOne needs at least 2 replicas"
+        for rid in range(n_targets):
             c = Client(maddr, check=check)
             c.connect(rid)
             self.clients.append(c)
@@ -305,10 +324,25 @@ class MultiClient:
                        for r in range(len(self.clients))]
             for t in threads:
                 t.start()
-            for t in threads:
-                t.join(timeout=timeout_s + 10)
-            done = sum(r["acked"] for r in results if r)
-            dups = sum(r["duplicates"] for r in results if r)
+            if self.wait_less and len(threads) > 1:
+                # stop waiting once all but one partition finished
+                # (clienttot -waitLess): poll results, leave the
+                # straggler's daemon thread draining. Count acks from
+                # the reply books, not per-thread results — the
+                # straggler HAS acked most of its partition by now and
+                # those are real commits
+                deadline = time.monotonic() + timeout_s + 10
+                while (sum(r is not None for r in results)
+                       < len(threads) - 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+                done = sum(len(c.replies) for c in self.clients)
+                dups = sum(c.dup_replies for c in self.clients)
+            else:
+                for t in threads:
+                    t.join(timeout=timeout_s + 10)
+                done = sum(r["acked"] for r in results if r)
+                dups = sum(r["duplicates"] for r in results if r)
         else:  # fast: fan out to all, first success wins
             deadline = t0 + timeout_s
             for lo in range(0, n, batch):
@@ -345,4 +379,5 @@ class MultiClient:
 
     def close(self) -> None:
         for c in self.clients:
+            c._done = True  # stragglers must not resurrect via failover
             c.close_conn()

@@ -745,3 +745,33 @@ def test_mencius_chaos_owner_churn_exactly_once(harness):
             h.start_replica(victim)
         time.sleep(0.3)
     cli.close_conn()
+
+
+def test_multiclient_bar_one_and_wait_less(harness):
+    """clienttot's -barOne (send to all replicas but the last,
+    clienttot/client.go:31, :76-78) and -waitLess (wait for all but
+    one partition, :32, :191-199): last replica serves no proposals,
+    every command still acks exactly-once."""
+    from minpaxos_tpu.runtime.client import MultiClient
+
+    h = harness()
+    mc = MultiClient(("127.0.0.1", h.mport), check=True, mode="rr",
+                     bar_one=True)
+    assert len(mc.clients) == 2  # 3 replicas, last excluded
+    ops, keys, vals = gen_workload(300, seed=21)
+    stats = mc.run_workload(ops, keys, vals, timeout_s=60)
+    assert stats["acked"] == 300 and stats["duplicates"] == 0, stats
+    # the excluded replica never saw a client proposal
+    assert h.servers[2].stats["proposals"] == 0
+    mc.close()
+    # -waitLess: the driver returns once all but one partition
+    # finished; the straggler's tail may be uncounted (that IS the
+    # semantics — tolerate one slow replica), but nothing duplicates
+    mc2 = MultiClient(("127.0.0.1", h.mport), check=True, mode="rr",
+                      wait_less=True)
+    ops2, keys2, vals2 = gen_workload(300, seed=22)
+    stats2 = mc2.run_workload(ops2, keys2, vals2, timeout_s=60)
+    per_part = 300 // len(mc2.clients) + 1
+    assert stats2["acked"] >= 300 - per_part, stats2
+    assert stats2["duplicates"] == 0
+    mc2.close()

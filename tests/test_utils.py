@@ -63,3 +63,42 @@ def test_clocks_monotone():
 
 def test_dlog_noop():
     dlog("hello %d", 42)  # must not raise in either mode
+
+
+def test_conflict_matches_reference_semantics():
+    """ops/conflict.py vs a literal port of state.go:53-71 run on
+    random batches (incl. masked rows)."""
+    import jax
+    import numpy as np
+
+    from minpaxos_tpu.ops.conflict import conflict, conflict_batch, is_read
+    from minpaxos_tpu.wire.messages import Op
+
+    def ref_conflict(a, b):
+        return a[1] == b[1] and (a[0] in (int(Op.PUT), int(Op.DELETE))
+                                 or b[0] in (int(Op.PUT), int(Op.DELETE)))
+
+    rng = np.random.default_rng(5)
+    for _ in range(20):
+        na, nb = rng.integers(1, 12, 2)
+        A = [(int(rng.choice([Op.PUT, Op.GET, Op.DELETE])),
+              int(rng.integers(0, 6))) for _ in range(na)]
+        B = [(int(rng.choice([Op.PUT, Op.GET, Op.DELETE])),
+              int(rng.integers(0, 6))) for _ in range(nb)]
+        va = rng.random(na) < 0.8
+        vb = rng.random(nb) < 0.8
+        want = any(ref_conflict(a, b)
+                   for a, ka in zip(A, va) if ka
+                   for b, kb in zip(B, vb) if kb)
+        got = jax.jit(conflict_batch)(
+            np.array([a[0] for a in A]), np.zeros(na, np.int32),
+            np.array([a[1] for a in A], np.int32),
+            np.array([b[0] for b in B]), np.zeros(nb, np.int32),
+            np.array([b[1] for b in B], np.int32),
+            va, vb)
+        assert bool(got) == want
+    # elementwise + is_read
+    assert bool(conflict(int(Op.GET), 0, 7, int(Op.PUT), 0, 7))
+    assert not bool(conflict(int(Op.GET), 0, 7, int(Op.GET), 0, 7))
+    assert not bool(conflict(int(Op.PUT), 0, 7, int(Op.PUT), 0, 8))
+    assert bool(is_read(np.int32(int(Op.GET))))
