@@ -35,10 +35,22 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 from __future__ import annotations
 
+import os
 import json
 import sys
 import threading
 import time
+
+
+# MP_BENCH_SUBSTEPS=2 appends a drain-only delivery sub-step per fused
+# round: commits land in fewer rounds (commit-on-quorum in the round
+# the quorum forms) at ~1.5-2x the round wall. SHAPE-DEPENDENT on the
+# CPU mesh: at the headline shape (g=8, w=4096, p=512) quorum p50
+# measured 2134 -> 1640 ms wall (-23%) with commits +5%, but at the
+# small reference shape it LOST both ways (p50 50 -> 75 ms,
+# throughput 31k -> 14k inst/s). Default 1; the record carries the
+# value used, so any substeps>1 number is labeled as such.
+SS_N = int(os.environ.get("MP_BENCH_SUBSTEPS", "1"))
 
 
 def _progress(msg: str) -> None:
@@ -127,14 +139,14 @@ def _side_config(cfg, g, p, k, protocol, dispatches=2):
                         key_space=1 << (cfg.kv_pow2 - 1))
     if protocol != "mencius":
         sc.elect(0)
-    sc.run_fused(k, p)  # compile + warm
+    sc.run_fused(k, p, substeps=SS_N)  # compile + warm
     start = sc.committed()[0]
     u0, c0 = shard_cursors(cfg, max(sc.leader, 0), sc.ss)
     # pre-phase cursor row: without it round-1 injections are censored
     U, C = [np.asarray(u0)[None].copy()], [np.asarray(c0)[None].copy()]
     t0 = time.perf_counter()
     for _ in range(dispatches):
-        u, c = sc.run_fused(k, p)
+        u, c = sc.run_fused(k, p, substeps=SS_N)
         U.append(u)
         C.append(c)
     wall = time.perf_counter() - t0
@@ -144,7 +156,7 @@ def _side_config(cfg, g, p, k, protocol, dispatches=2):
     # drain so the slowest (late-injected) slots enter the sample
     drain_rounds = 0
     for _ in range(6):
-        u, c = sc.run_fused(k, 0)
+        u, c = sc.run_fused(k, 0, substeps=SS_N)
         U.append(u)
         C.append(c)
         drain_rounds += k
@@ -238,15 +250,15 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         _progress(f"elect {time.perf_counter() - t_boot:.1f}s")
 
         # -- warmup / compile (k, k_dead and k=1 variants) --
-        sc.run_fused(k, p)
-        sc.run_fused(k_dead, p)
-        sc.run_fused(1, p)
+        sc.run_fused(k, p, substeps=SS_N)
+        sc.run_fused(k_dead, p, substeps=SS_N)
+        sc.run_fused(1, p, substeps=SS_N)
         _progress(f"warmup/compile {time.perf_counter() - t_boot:.1f}s")
 
         # -- dispatch overhead probe: k=1 dispatches, blocked --
         t0 = time.perf_counter()
         for _ in range(3):
-            sc.run_fused(1, p)  # np.asarray inside blocks until ready
+            sc.run_fused(1, p, substeps=SS_N)  # np.asarray inside blocks until ready
         k1_ms = (time.perf_counter() - t0) / 3 * 1e3
 
         # -- optional device profile: MP_BENCH_PROFILE=<dir> wraps the
@@ -267,7 +279,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         walls = [time.perf_counter()]
         with prof_cm:
             for i in range(healthy_d):
-                u, c = sc.run_fused(k, p)
+                u, c = sc.run_fused(k, p, substeps=SS_N)
                 U.append(u)
                 C.append(c)
                 walls.append(time.perf_counter())
@@ -284,7 +296,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         victim = 2
         sc.kill(victim)
         t0 = time.perf_counter()
-        du, dc = sc.run_fused(k_dead, p)
+        du, dc = sc.run_fused(k_dead, p, substeps=SS_N)
         DU, DC = [du], [dc]
         dead_wall = time.perf_counter() - t0
         committed_dead = int((DU[-1][-1] + 1).sum()) - int((U[-1][-1] + 1).sum())
@@ -300,7 +312,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         RU, RC = [], []
         t0 = time.perf_counter()
         for d in range(rec_d):
-            u, c = sc.run_fused(k, p)
+            u, c = sc.run_fused(k, p, substeps=SS_N)
             RU.append(u)
             RC.append(c)
             vup = np.asarray(sc.ss.states.committed_upto[:, victim])
@@ -315,7 +327,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         # tail in the latency sample) --
         drain_rounds = 0
         for _ in range(8):
-            u, c = sc.run_fused(k, 0)
+            u, c = sc.run_fused(k, 0, substeps=SS_N)
             RU.append(u)
             RC.append(c)
             drain_rounds += k
@@ -350,6 +362,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
             "latency_uncommitted_after_drain": uncommitted,
             "drain_rounds": drain_rounds,
             "concurrent_instances": g * w,
+        "substeps": SS_N,
             "proposals_per_round": g * p,
             "committed_total": committed_total,
             "kill_recover": {

@@ -21,11 +21,14 @@ import time
 import numpy as np
 
 
-def _tot_sampler(cli, stop, counts, interval_s=0.01):
+def _tot_sampler(clients, stop, counts, interval_s=0.01):
     """clienttot: sample cumulative acked every 10ms
-    (clienttot/client.go:229-238)."""
+    (clienttot/client.go:229-238). ``clients``: every connection the
+    driver acks on — with -e/-f that is the MultiClient's sub-clients
+    (sampling the unused single connection would print zeros)."""
     while not stop.is_set():
-        counts.append((time.monotonic(), len(cli.replies)))
+        counts.append((time.monotonic(),
+                       sum(len(c.replies) for c in clients)))
         time.sleep(interval_s)
 
 
@@ -247,8 +250,9 @@ def main(argv=None) -> None:
             counts: list = []
             stop = threading.Event()
             if args.tot:
+                sampled = multi.clients if multi is not None else [cli]
                 sampler = threading.Thread(
-                    target=_tot_sampler, args=(cli, stop, counts),
+                    target=_tot_sampler, args=(sampled, stop, counts),
                     daemon=True)
                 sampler.start()
             t0 = time.monotonic()

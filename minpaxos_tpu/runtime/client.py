@@ -336,6 +336,16 @@ class MultiClient:
                        < len(threads) - 1
                        and time.monotonic() < deadline):
                     time.sleep(0.01)
+                # stop the straggler (bounded): a partition thread left
+                # proposing into the next -r round's reused cmd_id
+                # space would corrupt its ack counts and -check
+                for r, res in enumerate(results):
+                    if res is None:
+                        self.clients[r]._done = True
+                for t in threads:
+                    t.join(timeout=4.0)
+                for c in self.clients:
+                    c._done = False
                 done = sum(len(c.replies) for c in self.clients)
                 dups = sum(c.dup_replies for c in self.clients)
             else:
