@@ -41,6 +41,7 @@ from minpaxos_tpu.models.minpaxos import (
     replica_step_impl,
 )
 from minpaxos_tpu.ops.packed import join_i64, split_i64
+from minpaxos_tpu.ops.winner import gather_row, slot_winner
 from minpaxos_tpu.wire.messages import MsgKind, Op
 
 
@@ -82,9 +83,13 @@ def _route(cfg: MinPaxosConfig, out_msgs: MsgBatch, dst: jnp.ndarray,
             (fdst == -1) | (fdst == me))
         pos = jnp.cumsum(mine.astype(jnp.int32)) - 1
         tgt = jnp.where(mine & (pos < capacity), pos, capacity)
+        # ONE scatter of the source row index (positions are unique by
+        # construction), then a dense gather per column: per-column
+        # scatters serialize on TPU (ops/winner.py rationale)
+        win, hit = slot_winner(capacity, tgt, mine & (pos < capacity))
         return jax.tree_util.tree_map(
-            lambda col: jnp.zeros(capacity, col.dtype).at[tgt].set(
-                col, mode="drop"),
+            lambda col: gather_row(win, hit, col,
+                                   jnp.zeros(capacity, col.dtype)),
             flat)
 
     return jax.vmap(inbox_for)(jnp.arange(r))

@@ -71,6 +71,7 @@ from minpaxos_tpu.ops.ackruns import (
 )
 from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
 from minpaxos_tpu.ops.scan import commit_frontier, segmented_scan_max
+from minpaxos_tpu.ops.winner import gather_const, gather_row, slot_winner
 from minpaxos_tpu.wire.messages import MsgKind, Op
 
 
@@ -175,19 +176,21 @@ def mencius_step_impl(
     slots_p = state.crt_own + R * prefix
     rel_p = slots_p - state.window_base
     fits = is_propose & (rel_p >= 0) & (rel_p < S)
-    tgt_p = jnp.where(fits, rel_p, S)
     me_bit = (jnp.int32(1) << me).astype(jnp.uint16)
+    # one winning row per slot + dense gathers instead of per-column
+    # scatters (ops/winner.py; targets unique by the cumsum)
+    win_p, hit_p = slot_winner(S, rel_p, fits)
     state = state._replace(
-        ballot=state.ballot.at[tgt_p].set(0, mode="drop"),
-        status=state.status.at[tgt_p].set(jnp.uint8(ACCEPTED), mode="drop"),
-        op=state.op.at[tgt_p].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt_p].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt_p].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt_p].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt_p].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt_p].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt_p].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_p].set(me_bit, mode="drop"),
+        ballot=gather_const(hit_p, 0, state.ballot),
+        status=gather_const(hit_p, ACCEPTED, state.status),
+        op=gather_row(win_p, hit_p, inbox.op, state.op),
+        key_hi=gather_row(win_p, hit_p, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_p, hit_p, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_p, hit_p, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_p, hit_p, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_p, hit_p, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_p, hit_p, inbox.client_id, state.client_id),
+        votes=gather_const(hit_p, me_bit, state.votes),
     )
     n_prop = jnp.where(fits, 1, 0).sum()
     state = state._replace(
@@ -230,17 +233,17 @@ def mencius_step_impl(
     ab_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
         jnp.where(acc_pre, rel_a, S)].max(inbox.ballot, mode="drop")
     acc_ok = acc_pre & (inbox.ballot == ab_max[rel_a_safe])
-    tgt_a = jnp.where(acc_ok, rel_a, S)
+    win_a, hit_a = slot_winner(S, rel_a, acc_ok)
     state = state._replace(
-        ballot=state.ballot.at[tgt_a].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_a].set(jnp.uint8(ACCEPTED), mode="drop"),
-        op=state.op.at[tgt_a].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt_a].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt_a].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt_a].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt_a].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt_a].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt_a].set(inbox.client_id, mode="drop"),
+        ballot=gather_row(win_a, hit_a, inbox.ballot, state.ballot),
+        status=gather_const(hit_a, ACCEPTED, state.status),
+        op=gather_row(win_a, hit_a, inbox.op, state.op),
+        key_hi=gather_row(win_a, hit_a, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_a, hit_a, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_a, hit_a, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_a, hit_a, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_a, hit_a, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_a, hit_a, inbox.client_id, state.client_id),
         # crt_inst ("max slot seen + 1, any owner") advances from ANY
         # owner-plausible ACCEPT — including beyond-window ones a
         # revived laggard can't apply. Without this its in_flight stays
@@ -382,17 +385,18 @@ def mencius_step_impl(
     # ---- 6. COMMIT rows (explicit commit transfer, bcastCommit) ----
     rel_c, in_win_c = _rel(state, inbox.inst, S)
     com_ok = is_commit & in_win_c
-    tgt_c = jnp.where(com_ok, rel_c, S)
+    win_c, hit_c = slot_winner(S, rel_c, com_ok)
     state = state._replace(
-        ballot=state.ballot.at[tgt_c].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_c].max(jnp.uint8(COMMITTED), mode="drop"),
-        op=state.op.at[tgt_c].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt_c].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt_c].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt_c].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt_c].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt_c].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt_c].set(inbox.client_id, mode="drop"),
+        ballot=gather_row(win_c, hit_c, inbox.ballot, state.ballot),
+        status=jnp.where(hit_c, jnp.maximum(state.status, COMMITTED),
+                         state.status),
+        op=gather_row(win_c, hit_c, inbox.op, state.op),
+        key_hi=gather_row(win_c, hit_c, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_c, hit_c, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_c, hit_c, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_c, hit_c, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_c, hit_c, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_c, hit_c, inbox.client_id, state.client_id),
         # any COMMIT row advances crt_inst by both its inst and its
         # piggybacked sender frontier (last_committed): a healing
         # laggard otherwise thinks the log ends at each served chunk,
@@ -458,18 +462,18 @@ def mencius_step_impl(
     vb_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
         jnp.where(pir_ok, rel_v, S)].max(inbox.ballot, mode="drop")
     pir_win = pir_ok & (inbox.ballot == vb_max[rel_v_safe])
-    tgt_v = jnp.where(pir_win, rel_v, S)
+    win_v, hit_v = slot_winner(S, rel_v, pir_win)
     state = state._replace(
-        ballot=state.ballot.at[tgt_v].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_v].set(jnp.uint8(ACCEPTED), mode="drop"),
-        op=state.op.at[tgt_v].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt_v].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt_v].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt_v].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt_v].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt_v].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt_v].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_v].set(me_bit, mode="drop"),
+        ballot=gather_row(win_v, hit_v, inbox.ballot, state.ballot),
+        status=gather_const(hit_v, ACCEPTED, state.status),
+        op=gather_row(win_v, hit_v, inbox.op, state.op),
+        key_hi=gather_row(win_v, hit_v, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_v, hit_v, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_v, hit_v, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_v, hit_v, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_v, hit_v, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_v, hit_v, inbox.client_id, state.client_id),
+        votes=gather_const(hit_v, me_bit, state.votes),
     )
 
     # ---- 8. commit scan: my owned slots at majority, frontier ----
@@ -603,11 +607,14 @@ def mencius_step_impl(
         ballot=jnp.full(K2, tb, jnp.int32),
         inst=tk_slots,
     )
+    tk_row = idx - tk_rel[0]
     state = state._replace(
-        # constant me_bit under duplicate indices: plain .set is a
-        # safe scatter-OR through the zeros temp
-        pvotes=state.pvotes | jnp.zeros(S, jnp.uint16).at[
-            jnp.where(tk_ok, tk_rel, S)].set(me_bit, mode="drop"))
+        # tk_rel is a contiguous range: slot s's source row is
+        # s - tk_rel[0], so the OR-delta is a dense select (no scatter)
+        pvotes=state.pvotes | jnp.where(
+            (tk_row >= 0) & (tk_row < K2)
+            & tk_ok[jnp.clip(tk_row, 0, K2 - 1)],
+            me_bit, jnp.uint16(0)))
     # no-op fill empties with a phase-1 majority; re-drive adopted
     # values; both as ACCEPTs at the takeover ballot
     pv_cnt = jax.lax.population_count(state.pvotes).astype(jnp.int32)

@@ -45,6 +45,7 @@ from minpaxos_tpu.ops.ackruns import (
 )
 from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
 from minpaxos_tpu.ops.scan import commit_frontier
+from minpaxos_tpu.ops.winner import gather_const, gather_row, slot_winner
 from minpaxos_tpu.wire.messages import MsgKind
 
 # Log-slot statuses (reference minpaxosproto.go:8-15 plus EXECUTED,
@@ -403,18 +404,20 @@ def replica_step_impl(
     vb_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
         jnp.where(pir_ok, rel_v, S)].max(inbox.ballot, mode="drop")
     pir_win = pir_ok & (inbox.ballot == vb_max[rel_v_safe])
-    tgt_v = jnp.where(pir_win, rel_v, S)
+    # one winning row per slot, then dense gathers (ops/winner.py: ten
+    # per-column scatters serialize on TPU; this is one scatter total)
+    win_v, hit_v = slot_winner(S, rel_v, pir_win)
     state = state._replace(
-        ballot=state.ballot.at[tgt_v].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_v].set(jnp.uint8(ACCEPTED), mode="drop"),
-        op=state.op.at[tgt_v].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt_v].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt_v].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt_v].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt_v].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt_v].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt_v].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_v].set(me_bit, mode="drop"),
+        ballot=gather_row(win_v, hit_v, inbox.ballot, state.ballot),
+        status=gather_const(hit_v, ACCEPTED, state.status),
+        op=gather_row(win_v, hit_v, inbox.op, state.op),
+        key_hi=gather_row(win_v, hit_v, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_v, hit_v, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_v, hit_v, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_v, hit_v, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_v, hit_v, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_v, hit_v, inbox.client_id, state.client_id),
+        votes=gather_const(hit_v, me_bit, state.votes),
         crt_inst=jnp.maximum(
             state.crt_inst, jnp.max(jnp.where(pir_ok, inbox.inst, -1)) + 1),
     )
@@ -446,19 +449,19 @@ def replica_step_impl(
     ab_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
         jnp.where(acc_pre, rel_a, S)].max(inbox.ballot, mode="drop")
     acc_ok = acc_pre & (inbox.ballot == ab_max[rel_a_safe])
-    tgt = jnp.where(acc_ok, rel_a, S)  # S drops
+    win_a, hit_a = slot_winner(S, rel_a, acc_ok)
     state = state._replace(
-        ballot=state.ballot.at[tgt].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt].set(jnp.uint8(ACCEPTED), mode="drop"),
-        op=state.op.at[tgt].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt].set(inbox.client_id, mode="drop"),
+        ballot=gather_row(win_a, hit_a, inbox.ballot, state.ballot),
+        status=gather_const(hit_a, ACCEPTED, state.status),
+        op=gather_row(win_a, hit_a, inbox.op, state.op),
+        key_hi=gather_row(win_a, hit_a, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_a, hit_a, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_a, hit_a, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_a, hit_a, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_a, hit_a, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_a, hit_a, inbox.client_id, state.client_id),
         # accepting a newer ballot supersedes any older votes
-        votes=state.votes.at[tgt].set(src_bit, mode="drop"),
+        votes=gather_row(win_a, hit_a, src_bit, state.votes),
         default_ballot=jnp.maximum(state.default_ballot,
                                    jnp.max(jnp.where(is_accept, inbox.ballot, NO_BALLOT))),
         max_recv_ballot=jnp.maximum(state.max_recv_ballot,
@@ -601,17 +604,18 @@ def replica_step_impl(
         leader_id=jnp.where(adopt_com, com_src, state.leader_id))
     rel_c, in_win_c = _rel(state, inbox.inst, S)
     com_ok = is_commit & in_win_c
-    tgt_c = jnp.where(com_ok, rel_c, S)
+    win_c, hit_c = slot_winner(S, rel_c, com_ok)
     state = state._replace(
-        ballot=state.ballot.at[tgt_c].set(inbox.ballot, mode="drop"),
-        status=state.status.at[tgt_c].max(jnp.uint8(COMMITTED), mode="drop"),
-        op=state.op.at[tgt_c].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt_c].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt_c].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt_c].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt_c].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt_c].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt_c].set(inbox.client_id, mode="drop"),
+        ballot=gather_row(win_c, hit_c, inbox.ballot, state.ballot),
+        status=jnp.where(hit_c, jnp.maximum(state.status, COMMITTED),
+                         state.status),
+        op=gather_row(win_c, hit_c, inbox.op, state.op),
+        key_hi=gather_row(win_c, hit_c, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_c, hit_c, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_c, hit_c, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_c, hit_c, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_c, hit_c, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_c, hit_c, inbox.client_id, state.client_id),
         crt_inst=jnp.maximum(
             state.crt_inst, jnp.max(jnp.where(com_ok, inbox.inst, -1)) + 1),
     )
@@ -654,18 +658,18 @@ def replica_step_impl(
     slots = state.crt_inst + slot_off
     rel_p = slots - state.window_base
     fits = prop & (rel_p >= 0) & (rel_p < S)
-    tgt_p = jnp.where(fits, rel_p, S)
+    win_p, hit_p = slot_winner(S, rel_p, fits)  # targets unique by cumsum
     state = state._replace(
-        ballot=state.ballot.at[tgt_p].set(state.default_ballot, mode="drop"),
-        status=state.status.at[tgt_p].set(jnp.uint8(ACCEPTED), mode="drop"),
-        op=state.op.at[tgt_p].set(inbox.op.astype(jnp.uint8), mode="drop"),
-        key_hi=state.key_hi.at[tgt_p].set(inbox.key_hi, mode="drop"),
-        key_lo=state.key_lo.at[tgt_p].set(inbox.key_lo, mode="drop"),
-        val_hi=state.val_hi.at[tgt_p].set(inbox.val_hi, mode="drop"),
-        val_lo=state.val_lo.at[tgt_p].set(inbox.val_lo, mode="drop"),
-        cmd_id=state.cmd_id.at[tgt_p].set(inbox.cmd_id, mode="drop"),
-        client_id=state.client_id.at[tgt_p].set(inbox.client_id, mode="drop"),
-        votes=state.votes.at[tgt_p].set(me_bit, mode="drop"),
+        ballot=gather_const(hit_p, state.default_ballot, state.ballot),
+        status=gather_const(hit_p, ACCEPTED, state.status),
+        op=gather_row(win_p, hit_p, inbox.op, state.op),
+        key_hi=gather_row(win_p, hit_p, inbox.key_hi, state.key_hi),
+        key_lo=gather_row(win_p, hit_p, inbox.key_lo, state.key_lo),
+        val_hi=gather_row(win_p, hit_p, inbox.val_hi, state.val_hi),
+        val_lo=gather_row(win_p, hit_p, inbox.val_lo, state.val_lo),
+        cmd_id=gather_row(win_p, hit_p, inbox.cmd_id, state.cmd_id),
+        client_id=gather_row(win_p, hit_p, inbox.client_id, state.client_id),
+        votes=gather_const(hit_p, me_bit, state.votes),
         crt_inst=state.crt_inst + jnp.where(fits, 1, 0).sum(),
     )
     # broadcast ACCEPT rows for accepted proposals; rejection replies
@@ -910,18 +914,23 @@ def replica_step_impl(
     # bump retried slots to the current ballot (resetting votes when
     # the ballot actually changes), so follower acks count
     bump = rt_ok & (state.ballot[rt_rel_safe] != state.default_ballot)
-    tgt_b = jnp.where(bump, rt_rel, S)
+    # rt_rel is the contiguous range [rt_rel[0], rt_rel[0]+K): each
+    # slot's source row is arithmetic (slot - rt_rel[0]) — the masked
+    # writes become dense gathers with NO scatter (ops/winner.py)
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    rt_row = sidx - rt_rel[0]
+    rt_row_safe = jnp.clip(rt_row, 0, K - 1)
+    in_rt = (rt_row >= 0) & (rt_row < K)
+    hit_b = in_rt & bump[rt_row_safe]
+    hit_n = in_rt & noop_fill[rt_row_safe]
     state = state._replace(
-        ballot=state.ballot.at[tgt_b].set(state.default_ballot, mode="drop"),
-        status=state.status.at[jnp.where(noop_fill, rt_rel, S)].set(
-            jnp.uint8(ACCEPTED), mode="drop"),
-        op=state.op.at[jnp.where(noop_fill, rt_rel, S)].set(
-            jnp.uint8(0), mode="drop"),
-        cmd_id=state.cmd_id.at[jnp.where(noop_fill, rt_rel, S)].set(
-            0, mode="drop"),
-        client_id=state.client_id.at[jnp.where(noop_fill, rt_rel, S)].set(
-            -1, mode="drop"),
-        votes=state.votes.at[tgt_b].set(me_bit, mode="drop"),
+        ballot=jnp.where(hit_b, state.default_ballot, state.ballot),
+        status=jnp.where(hit_n, jnp.asarray(ACCEPTED, state.status.dtype),
+                         state.status),
+        op=jnp.where(hit_n, jnp.uint8(0), state.op),
+        cmd_id=jnp.where(hit_n, 0, state.cmd_id),
+        client_id=jnp.where(hit_n, -1, state.client_id),
+        votes=jnp.where(hit_b, me_bit, state.votes),
     )
     rt = MsgBatch(
         kind=jnp.where(rt_ok, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
@@ -963,6 +972,7 @@ def replica_step_impl(
     cursor = jnp.maximum(cursor, state.committed_upto + 1)
     pi_slots = cursor + jnp.arange(K2, dtype=jnp.int32)
     pi_rel = pi_slots - state.window_base
+    pi_row = sidx - pi_rel[0]
     pi_rel_safe = jnp.clip(pi_rel, 0, S - 1)
     pi_ok = sweep_on & (pi_slots < eff_limit) & (pi_rel >= 0) & (
         pi_rel < S)
@@ -973,11 +983,13 @@ def replica_step_impl(
         inst=pi_slots,
     )
     state = state._replace(
-        # the leader answers its own phase 1 as it sweeps (duplicate
-        # indices write the same constant me_bit, so plain .set is a
-        # safe scatter-OR here)
-        pvotes=state.pvotes | jnp.zeros(S, jnp.uint16).at[
-            jnp.where(pi_ok, pi_rel, S)].set(me_bit, mode="drop"),
+        # the leader answers its own phase 1 as it sweeps; pi_rel is a
+        # contiguous range, so the OR-delta is a dense masked select
+        # (slot s's source row is s - pi_rel[0]; no scatter)
+        pvotes=state.pvotes | jnp.where(
+            (pi_row >= 0) & (pi_row < K2)
+            & pi_ok[jnp.clip(pi_row, 0, K2 - 1)],
+            me_bit, jnp.uint16(0)),
         rec_cursor=jnp.where(
             sweep_on, jnp.minimum(cursor + K2, eff_limit), cursor),
     )
@@ -1019,9 +1031,10 @@ def replica_step_impl(
     state = state._replace(
         kv=kv,
         executed_upto=state.executed_upto + n_exec,
+        # executed slots form the contiguous range [rel_e[0],
+        # rel_e[0] + n_exec): a range test, not a scatter
         status=jnp.where(
-            (jnp.zeros(S, bool).at[jnp.where(evalid, rel_e, S)].set(
-                True, mode="drop")),
+            (sidx >= rel_e[0]) & (sidx < rel_e[0] + n_exec),
             EXECUTED, state.status),
     )
     execr = ExecResult(

@@ -1,0 +1,50 @@
+"""Scatter-free slot updates: pick one winning inbox row per window
+slot, then GATHER its columns.
+
+The protocol step's hot sections (models/minpaxos.py 1c/2/3/5,
+models/cluster.py _route) each write ~10 message columns into per-slot
+arrays. Written as ten independent ``at[tgt].set`` scatters, XLA:TPU
+lowers each to a serialized per-update loop — and under the [G, R] vmap
+of the sharded bench that serialization multiplies out to tens of
+millions of scattered rows per round (measured: ~674 ms/round at the
+131k-instance rung, BENCH round 5). The rewrite here pays ONE small
+scatter (max of row index per slot) and turns every column write into a
+dense gather, which the TPU vectorizes.
+
+Semantics preserved: sections already dedupe multi-row slot conflicts
+by max ballot before writing (minpaxos.py ``ab_max``/``vb_max``); among
+equal-priority rows the highest row index wins deterministically (the
+old per-column scatters picked an unspecified duplicate — this is
+strictly tighter).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def slot_winner(size: int, rel, ok):
+    """Per-slot winning row: ``win[s]`` = max row index among rows with
+    ``ok`` whose target is slot ``rel`` (-1 if none), plus ``hit`` mask.
+
+    One [M]-row scatter-max into a [size+1] i32 array (row ``size``
+    absorbs masked-off rows).
+    """
+    m = ok.shape[0]
+    rows = jnp.arange(m, dtype=jnp.int32)
+    win = jnp.full(size + 1, -1, jnp.int32).at[
+        jnp.where(ok, rel, size)].max(rows, mode="drop")[:size]
+    return win, win >= 0
+
+
+def gather_row(win, hit, col, old):
+    """new[s] = col[win[s]] where hit else old[s] — a dense gather."""
+    picked = col[jnp.clip(win, 0)]
+    if picked.dtype != old.dtype:
+        picked = picked.astype(old.dtype)
+    return jnp.where(hit, picked, old)
+
+
+def gather_const(hit, value, old):
+    """new[s] = value where hit else old[s] (constant-fill variant)."""
+    return jnp.where(hit, jnp.asarray(value, old.dtype), old)

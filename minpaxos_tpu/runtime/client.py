@@ -344,8 +344,13 @@ class MultiClient:
                         self.clients[r]._done = True
                 for t in threads:
                     t.join(timeout=4.0)
-                for c in self.clients:
-                    c._done = False
+                # re-arm ONLY clients whose thread actually exited: a
+                # straggler still inside a blocking failover after the
+                # bounded join would resume proposing into the next
+                # round's reused cmd_id space if its _done were cleared
+                for c, t in zip(self.clients, threads):
+                    if not t.is_alive():
+                        c._done = False
                 done = sum(len(c.replies) for c in self.clients)
                 dups = sum(c.dup_replies for c in self.clients)
             else:

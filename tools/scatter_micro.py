@@ -1,0 +1,103 @@
+"""Scatter-pattern microbenchmark: what does a batched multi-column
+scatter cost on this backend, vs the gather-based rewrites?
+
+The protocol step writes inbox-rows into window arrays as ~10 separate
+per-column scatters per section (models/minpaxos.py sections 2/3/5),
+and the routing fabric compacts outboxes the same way (~12 columns,
+models/cluster.py _route). Under vmap over [G, R] those become batched
+scatters; if XLA:TPU serializes per update row, the step cost is
+O(sections * columns * batch * rows) — the hypothesis for the observed
+674 ms/round at g=64 (BENCH round 5, ~40M scattered rows/round).
+
+Candidates measured here at bench-rung-0-like shape (B=320 batch,
+M=1408 updates, S=2048 targets):
+
+  a. baseline   — 10 independent per-column scatters (today's code)
+  b. argmax+gather — 1 scatter-max of row index, then 10 gathers
+  c. onehot-matmul — one-hot [S, M] f32 matmul against [M, 10] payload
+
+Run (relay must be free): python tools/scatter_micro.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+B, M, S, NCOL = 320, 1408, 2048, 10
+
+
+def _time(fn, *args, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def main() -> None:
+    print(f"backend: {jax.devices()[0].platform}", file=sys.stderr)
+    rng = np.random.default_rng(0)
+    tgt = jnp.asarray(rng.integers(0, S + 1, (B, M)).astype(np.int32))
+    cols = jnp.asarray(rng.integers(0, 1 << 20, (B, NCOL, M)).astype(np.int32))
+    old = jnp.zeros((B, NCOL, S), jnp.int32)
+
+    @jax.jit
+    def scatter_percol(old, tgt, cols):
+        def one(o, t, c):
+            return jnp.stack([o[i].at[t].set(c[i], mode="drop")
+                              for i in range(NCOL)])
+        return jax.vmap(one)(old, tgt, cols)
+
+    @jax.jit
+    def argmax_gather(old, tgt, cols):
+        def one(o, t, c):
+            rows = jnp.arange(M, dtype=jnp.int32)
+            win = jnp.full(S + 1, -1, jnp.int32).at[t].max(rows,
+                                                           mode="drop")[:S]
+            hit = win >= 0
+            g = c[:, jnp.clip(win, 0)]          # [NCOL, S] gather
+            return jnp.where(hit[None, :], g, o)
+        return jax.vmap(one)(old, tgt, cols)
+
+    @jax.jit
+    def onehot_matmul(old, tgt, cols):
+        def one(o, t, c):
+            oh = (t[None, :] == jnp.arange(S)[:, None]).astype(jnp.float32)
+            # last-writer-wins not preserved (sums dups) — timing probe only
+            out = jnp.einsum("sm,cm->cs", oh, c.astype(jnp.float32))
+            hit = oh.sum(1) > 0
+            return jnp.where(hit[None, :], out.astype(jnp.int32), o)
+        return jax.vmap(one)(old, tgt, cols)
+
+    for name, fn in [("a. per-column scatter x10", scatter_percol),
+                     ("b. argmax + gather", argmax_gather),
+                     ("c. one-hot matmul", onehot_matmul)]:
+        ms = _time(fn, old, tgt, cols)
+        print(f"{name:28s} {ms:9.2f} ms  "
+              f"({B}x{M} rows -> {S} slots, {NCOL} cols)")
+
+    # single-column scatter scaling: is cost per-column or fixed?
+    @jax.jit
+    def scatter_onecol(old, tgt, cols):
+        return jax.vmap(lambda o, t, c: o.at[t].set(c, mode="drop"))(
+            old[:, 0], tgt, cols[:, 0])
+
+    ms1 = _time(scatter_onecol, old, tgt, cols)
+    print(f"d. single-column scatter     {ms1:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
