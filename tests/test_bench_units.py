@@ -184,3 +184,22 @@ def test_free_ports_impossible_request_raises():
     with pytest.raises(OSError):
         # no port p can have p+70000 as a sibling (> 65535)
         free_ports(1, sibling_offset=70000)
+
+
+def test_salvage_partial_prefers_last_parseable_tpu_record():
+    import bench
+
+    good = ('{"value": 37700.0, "platform": "tpu", '
+            '"partial": "healthy_phase_only"}')
+    # truncated final line (child killed mid-write) falls back to the
+    # earlier complete record
+    out = ("[noise]\n" + good + "\n" + '{"value": 999').encode()
+    assert bench.salvage_partial(out) == good
+    # a CPU fallback record must never masquerade as a TPU headline
+    assert bench.salvage_partial(
+        b'{"value": 1.0, "platform": "cpu"}') is None
+    assert bench.salvage_partial(None) is None
+    assert bench.salvage_partial(b"no json here") is None
+    # error records are not salvageable
+    assert bench.salvage_partial(
+        b'{"value": 0.0, "platform": "tpu", "error": "boom"}') is None
