@@ -60,6 +60,16 @@ SERVER_SHAPE = ["-window", "2048", "-inbox", "1024", "-kvpow2", "18",
 # drain — the tight minpaxos shape starved it (325 vs ~1.3k ops/s)
 MENCIUS_SHAPE = ["-window", "4096", "-inbox", "2048", "-kvpow2", "18",
                  "-execbatch", "512"]
+# Serial latency wants the OPPOSITE sizing from throughput: one op in
+# flight needs ~3 protocol ticks end-to-end and every tick is
+# window-linear with a KV-capacity floor, so the latency leg boots its
+# own small cluster (a 512-slot window holds the ~500 warm+serial
+# slots; kv 2^12 holds their distinct keys at ~0.1 load). At the
+# throughput shape the same path measured p50 ~20-22 ms; the reference
+# measures latency with a separate client the same way
+# (clientlat/client.go:134-160).
+SERIAL_SHAPE = ["-window", "512", "-inbox", "256", "-kvpow2", "12",
+                "-execbatch", "64"]
 
 
 def _progress(msg: str) -> None:
@@ -84,6 +94,39 @@ def _boot(proto_flag: str, env, tmp, shape) -> tuple[list, int]:
             env=env, cwd=tmp, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL))
     return procs, mport
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _cluster(proto_flag: str, shape):
+    """Boot master + 3 servers with a fresh store dir; yield the master
+    address; tear everything down (SIGTERM, then kill) and wipe the
+    stores on exit — the one copy of the lifecycle both the throughput
+    and serial legs use."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    tmp = REPO / ".bench_tcp_store"
+    tmp.mkdir(exist_ok=True)
+    for f in tmp.glob("stable-store-replica*"):
+        f.unlink()
+    procs, mport = _boot(proto_flag, env, tmp, shape)
+    try:
+        yield ("127.0.0.1", mport)
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        time.sleep(1.0)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        for f in tmp.glob("stable-store-replica*"):
+            f.unlink()
 
 
 def _connect_client(maddr, deadline_s: float = 90.0):
@@ -130,15 +173,8 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
     down. ``multi_rr``: drive throughput with the leaderless
     round-robin MultiClient (reference client.go -e) — the Mencius
     deployment's intended workload: all owners serve concurrently."""
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
-    tmp = REPO / ".bench_tcp_store"
-    tmp.mkdir(exist_ok=True)
-    for f in tmp.glob("stable-store-replica*"):
-        f.unlink()
     shape = MENCIUS_SHAPE if multi_rr else SERVER_SHAPE
-    procs, mport = _boot(proto_flag, env, tmp, shape)
-    maddr = ("127.0.0.1", mport)
-    try:
+    with _cluster(proto_flag, shape) as maddr:
         from minpaxos_tpu.runtime.client import (
             Client,
             MultiClient,
@@ -173,24 +209,6 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
             _progress(f"{label}: trial {t}: {rates[-1]} ops/s"
                       f" ({trial_stats[-1]})")
 
-        # latency leg: 200 serial one-at-a-time ops with UNIQUE
-        # cmd_ids (clientlat shape, clientlat/client.go:134-160),
-        # failover-robust: a rejection or dead socket re-routes
-        # instead of crashing the record (round-4 BrokenPipeError)
-        from minpaxos_tpu.cli.client import _propose_until_acked
-
-        cli = Client(maddr, check=True)
-        cli.connect()
-        lats = []
-        for i in range(200):
-            cid = np.asarray([1_000_000 + i])
-            t1 = time.perf_counter()
-            if _propose_until_acked(cli, cid, np.asarray([1]),
-                                    np.asarray([7000 + i]),
-                                    np.asarray([i]), timeout_s=10.0):
-                lats.append((time.perf_counter() - t1) * 1e3)
-        cli.close_conn()
-        lats.sort()
         # the headline median is over CLEAN trials only; if none
         # survived, the record keeps the all-trial median but its
         # "check" field carries every failure, so it cannot read as
@@ -204,27 +222,42 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
             "ops_per_sec_spread": [min(rates), max(rates)],
             "check": ("ok" if all(s == "ok" for s in trial_stats)
                       else trial_stats),
-            "serial_p50_ms": round(lats[len(lats) // 2], 3) if lats else None,
-            "serial_p99_ms": round(lats[int(len(lats) * 0.99)], 3)
-            if lats else None,
-            "n_serial": len(lats),
             "server_shape": " ".join(shape),
             "reference_shape": ref_shape,
         }
-    finally:
-        for p in procs:
-            try:
-                p.send_signal(signal.SIGTERM)
-            except OSError:
-                pass
-        time.sleep(1.0)
-        for p in procs:
-            try:
-                p.kill()
-            except OSError:
-                pass
-        for f in tmp.glob("stable-store-replica*"):
-            f.unlink()
+
+
+def run_serial(proto_flag: str, label: str) -> dict:
+    """Serial-latency leg on its own SERIAL_SHAPE cluster: 200
+    one-at-a-time ops with UNIQUE cmd_ids (clientlat shape,
+    clientlat/client.go:134-160), failover-robust (a rejection or dead
+    socket re-routes instead of crashing the record)."""
+    with _cluster(proto_flag, SERIAL_SHAPE) as maddr:
+        from minpaxos_tpu.cli.client import _propose_until_acked
+        from minpaxos_tpu.runtime.client import Client
+
+        _progress(f"{label}: serial cluster booting")
+        _warm(maddr)
+        cli = Client(maddr, check=True)
+        cli.connect()
+        lats = []
+        for i in range(200):
+            cid = np.asarray([1_000_000 + i])
+            t1 = time.perf_counter()
+            if _propose_until_acked(cli, cid, np.asarray([1]),
+                                    np.asarray([7000 + i]),
+                                    np.asarray([i]), timeout_s=10.0):
+                lats.append((time.perf_counter() - t1) * 1e3)
+        cli.close_conn()
+        lats.sort()
+        return {
+            "serial_p50_ms": round(lats[len(lats) // 2], 3)
+            if lats else None,
+            "serial_p99_ms": round(lats[int(len(lats) * 0.99)], 3)
+            if lats else None,
+            "n_serial": len(lats),
+            "serial_shape": " ".join(SERIAL_SHAPE),
+        }
 
 
 def main() -> None:
@@ -241,7 +274,12 @@ def main() -> None:
         "-min", "bareminpaxos_tcp_3rep_durable (BASELINE config 1)",
         "bareminrun.sh:16-21 + simpletest.sh:1", q, k)
     # persist the headline immediately: an abort during the minutes-long
-    # mencius leg (Ctrl-C, SIGTERM) must not discard a finished run
+    # later legs (Ctrl-C, SIGTERM) must not discard a finished run
+    out_path.write_text(json.dumps(rec) + "\n")
+    try:
+        rec.update(run_serial("-min", "bareminpaxos serial"))
+    except Exception as e:  # noqa: BLE001
+        rec["serial_error"] = repr(e)[:200]
     out_path.write_text(json.dumps(rec) + "\n")
     try:
         rec["mencius_tcp"] = run_config(
@@ -251,6 +289,14 @@ def main() -> None:
             multi_rr=True)
     except Exception as e:  # noqa: BLE001 — config 1 is the headline
         rec["mencius_tcp"] = {"error": repr(e)[:200]}
+    # persist the finished throughput leg before the serial leg: a
+    # serial-cluster warmup failure must not discard the 10-minute run
+    out_path.write_text(json.dumps(rec) + "\n")
+    if "error" not in rec["mencius_tcp"]:
+        try:
+            rec["mencius_tcp"].update(run_serial("-m", "mencius serial"))
+        except Exception as e:  # noqa: BLE001
+            rec["mencius_tcp"]["serial_error"] = repr(e)[:200]
     out_path.write_text(json.dumps(rec) + "\n")
     print(json.dumps(rec))
 

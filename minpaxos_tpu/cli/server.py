@@ -60,6 +60,20 @@ def main(argv=None) -> None:
                         " smaller cuts fixed per-tick exec-pipeline"
                         " cost, at the price of draining large commit"
                         " backlogs over more ticks")
+    p.add_argument("-noopdelay", type=int, default=50,
+                   help="stalled protocol ticks before recovery kicks "
+                        "in (Mencius takeover sweep, MinPaxos frontier "
+                        "rescan / gap no-op fill). A busy TCP replica "
+                        "ticks every ~2ms, so the pod-mode default (8) "
+                        "means ~16ms of peer silence triggers takeover "
+                        "churn — on a loaded host peers are routinely "
+                        "descheduled longer than that, and the resulting "
+                        "ballot-bump/re-drive storms collapsed the rr "
+                        "Mencius bench. 50 ticks is ~0.1s busy / ~2.5s "
+                        "idle (the reference waits ~5s before "
+                        "forceCommit, mencius.go:244-257); the routine "
+                        "loss rescuer is the in-ballot accept retry "
+                        "(models/mencius.py 9c), not takeover")
     p.add_argument("-gossipticks", type=int, default=4,
                    help="frontier-gossip cadence in ticks (1 ="
                         " immediate); >1 suppresses the per-commit"
@@ -108,7 +122,7 @@ def main(argv=None) -> None:
         n_replicas=len(nodes), window=args.window, inbox=args.inbox,
         exec_batch=args.execbatch or args.inbox, kv_pow2=args.kvpow2,
         catchup_rows=256, recovery_rows=256,
-        gossip_ticks=args.gossipticks,
+        gossip_ticks=args.gossipticks, noop_delay=args.noopdelay,
         explicit_commit=args.classic and not args.mencius)
     prof = cProfile.Profile() if args.cpuprofile else None
     flags = RuntimeFlags(dreply=args.dreply,

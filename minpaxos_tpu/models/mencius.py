@@ -108,6 +108,7 @@ class MenciusState(NamedTuple):
     max_recv_ballot: jnp.ndarray
     tick: jnp.ndarray
     stall_ticks: jnp.ndarray
+    peer_commits: jnp.ndarray  # i32[R] last frontier reported per peer
     kv: KVState
 
 
@@ -142,6 +143,7 @@ def init_mencius(cfg: MinPaxosConfig, me: int) -> MenciusState:
         max_recv_ballot=jnp.int32(0),
         tick=jnp.int32(0),
         stall_ticks=jnp.int32(0),
+        peer_commits=jnp.full(r, -1, dtype=jnp.int32),
         kv=kv_init(cfg.kv_pow2),
     )
 
@@ -378,9 +380,20 @@ def mencius_step_impl(
                                    stride=R)
     drv_slot = own_mask | (
         (state.ballot > 0) & (jnp.mod(state.ballot, 16) == me))
+    # peer frontier tracking (the minpaxos peer_commits scheme): every
+    # accept/ack/commit row carries its SENDER's committed_upto in
+    # last_committed. Adopt the batch-max report per peer rather than
+    # a running max so a crash-revived peer's LOWER report un-pins
+    # catch-up (reports are TCP-ordered within one process lifetime).
+    rep_row = (is_accept | is_areply | is_commit) & (inbox.src >= 0)
+    rep_src = jnp.where(rep_row, jnp.clip(inbox.src, 0, R - 1), R)
+    pc_seen = jnp.full(R + 1, jnp.int32(-(2 ** 30))).at[rep_src].max(
+        inbox.last_committed)
+    replied = pc_seen[:R] > -(2 ** 30)
     state = state._replace(
         votes=state.votes | pack_vote_bits(
-            vote_cov & drv_slot[:, None]))
+            vote_cov & drv_slot[:, None]),
+        peer_commits=jnp.where(replied, pc_seen[:R], state.peer_commits))
 
     # ---- 6. COMMIT rows (explicit commit transfer, bcastCommit) ----
     rel_c, in_win_c = _rel(state, inbox.inst, S)
@@ -434,8 +447,14 @@ def mencius_step_impl(
         inst=jnp.where(pi_answer, inbox.inst, out.inst),
         ballot=jnp.where(pi_val, state.ballot[rel_pi_safe],
                          jnp.where(pi_answer, NO_BALLOT, out.ballot)),
-        last_committed=jnp.where(pi_answer, inbox.ballot,
-                                 out.last_committed),
+        # COMMIT answers carry my real frontier (it feeds receivers'
+        # peer_commits, 9d, and crt_inst, section 6 — echoing the
+        # sweep ballot there poisoned catch-up targeting); PIR answers
+        # echo the sweep ballot as the 7b context tag, as in
+        # models/minpaxos.py 2b
+        last_committed=jnp.where(pi_com, state.committed_upto,
+                                 jnp.where(pi_answer, inbox.ballot,
+                                           out.last_committed)),
         op=jnp.where(pi_val, state.op[rel_pi_safe],
                      jnp.where(pi_answer, 0, out.op)),
         key_hi=jnp.where(pi_val, state.key_hi[rel_pi_safe], out.key_hi),
@@ -569,6 +588,83 @@ def mencius_step_impl(
         client_id=state.client_id[ta_rel_safe],
     )
 
+    # 9c. own-slot accept RETRY (mirror of models/minpaxos.py 7d).
+    # Without it, a lost ACCEPT or ack waits for the TAKEOVER sweep —
+    # the protocol's only other rescuer — so under load-induced inbox
+    # overflow the rr TCP bench ran at takeover cadence with constant
+    # ballot-bump/re-drive churn (round-5 repro: raising noop_delay
+    # alone collapsed throughput 1474 -> 1.4 ops/s). After 4 stalled
+    # steps, rebroadcast my still-unacked driven slots in the blocked
+    # range at their CURRENT ballot: no bump, no churn — peers dedupe
+    # re-accepts and re-ack committed content (section 2 acc_ok /
+    # acc_dup_ok), like the reference's leader re-sending accepts on
+    # its own clock rather than escalating (bareminpaxos.go analog;
+    # mencius.go relies on TCP never dropping, which the bounded inbox
+    # here does not guarantee).
+    K3 = cfg.catchup_rows
+    rt_slots = state.committed_upto + 1 + jnp.arange(K3, dtype=jnp.int32)
+    rt_rel = rt_slots - state.window_base
+    rt_rel_safe = jnp.clip(rt_rel, 0, S - 1)
+    rt_ok = ((state.stall_ticks >= 4) & (rt_rel >= 0) & (rt_rel < S)
+             & (rt_slots < state.crt_inst)
+             & driven_by_me[rt_rel_safe]
+             & (state.status[rt_rel_safe] == ACCEPTED)
+             & (n_votes[rt_rel_safe] < majority))
+    rt = MsgBatch(
+        kind=jnp.where(rt_ok, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
+        src=jnp.full(K3, me, jnp.int32),
+        ballot=state.ballot[rt_rel_safe],
+        inst=rt_slots,
+        last_committed=jnp.full(K3, state.committed_upto, jnp.int32),
+        op=state.op[rt_rel_safe].astype(jnp.int32),
+        key_hi=state.key_hi[rt_rel_safe],
+        key_lo=state.key_lo[rt_rel_safe],
+        val_hi=state.val_hi[rt_rel_safe],
+        val_lo=state.val_lo[rt_rel_safe],
+        cmd_id=state.cmd_id[rt_rel_safe],
+        client_id=state.client_id[rt_rel_safe],
+    )
+
+    # 9d. frontier catch-up (the minpaxos 7c scheme, which mencius
+    # lacked entirely): commit_sent announces each own committed slot
+    # ONCE, so a peer whose inbox overflowed during a burst loses those
+    # COMMIT rows forever, its frontier (and exec, and client replies)
+    # then advances only at the pace of whatever traffic it happens to
+    # re-learn from — observed as a replica trailing the others by 10k
+    # slots while "advancing" just enough that the stall-gated takeover
+    # never fired, flat-lining the rr bench. Cure: every step, re-serve
+    # up to catchup_rows committed slots to one lagging peer (worst /
+    # round-robin alternation as in models/minpaxos.py 7c), unicast.
+    pc_masked = jnp.where(jnp.arange(R) == me, jnp.int32(2 ** 30),
+                          state.peer_commits)
+    worst = jnp.argmin(pc_masked).astype(jnp.int32)
+    rr_peer = jnp.mod(state.tick // 2, R)
+    cu_peer = jnp.where(jnp.mod(state.tick, 2) == 0, worst, rr_peer)
+    cu_lag = state.peer_commits[cu_peer] < state.committed_upto
+    do_cu = (cu_peer != me) & cu_lag
+    K4 = cfg.catchup_rows
+    cu_slots = state.peer_commits[cu_peer] + 1 + jnp.arange(
+        K4, dtype=jnp.int32)
+    cu_rel = cu_slots - state.window_base
+    cu_rel_safe = jnp.clip(cu_rel, 0, S - 1)
+    cu_ok = (do_cu & (cu_slots <= state.committed_upto)
+             & (cu_rel >= 0) & (cu_rel < S)
+             & (state.status[cu_rel_safe] >= COMMITTED))
+    cu = MsgBatch(
+        kind=jnp.where(cu_ok, int(MsgKind.COMMIT), 0).astype(jnp.int32),
+        src=jnp.full(K4, me, jnp.int32),
+        ballot=state.ballot[cu_rel_safe],
+        inst=cu_slots,
+        last_committed=jnp.full(K4, state.committed_upto, jnp.int32),
+        op=state.op[cu_rel_safe].astype(jnp.int32),
+        key_hi=state.key_hi[cu_rel_safe],
+        key_lo=state.key_lo[cu_rel_safe],
+        val_hi=state.val_hi[cu_rel_safe],
+        val_lo=state.val_lo[cu_rel_safe],
+        cmd_id=state.cmd_id[cu_rel_safe],
+        client_id=state.client_id[cu_rel_safe],
+    )
+
     # ---- 10. takeover driver: successor sweeps the blocked range ----
     blocking = state.committed_upto + 1
     blk_owner = jnp.mod(blocking, R)
@@ -660,12 +756,14 @@ def mencius_step_impl(
                                   state.takeover_ballot))
 
     out = _concat_rows(_concat_rows(_concat_rows(_concat_rows(_concat_rows(
-        out, skip_row), cb), ta), tk), rd)
+        _concat_rows(_concat_rows(out, skip_row), cb), ta), rt), cu), tk), rd)
     dst = jnp.concatenate([
         dst,
         jnp.full(1, -1, jnp.int32),    # skip broadcast
         jnp.full(K, -1, jnp.int32),    # own-commit broadcast
         jnp.full(K2b, -1, jnp.int32),  # takeover-commit announce
+        jnp.full(K3, -1, jnp.int32),   # own-accept retry broadcast
+        jnp.full(K4, cu_peer, jnp.int32),  # catch-up -> lagging peer
         jnp.full(K2, -1, jnp.int32),   # takeover sweep
         jnp.full(K2, -1, jnp.int32),   # takeover re-drive
     ])
@@ -680,74 +778,92 @@ def mencius_step_impl(
     E = cfg.exec_batch
     exec_lo = state.executed_upto + 1
     rel_e0 = exec_lo - state.window_base
-    # in-order part
-    avail = state.committed_upto - state.executed_upto
-    n_inorder = jnp.clip(avail, 0, E)
-    in_prefix = (idx >= rel_e0) & (idx < rel_e0 + n_inorder)
-    # out-of-order part: committed slots above the frontier with no
-    # uncommitted conflicting predecessor in the window. Sort by
-    # (key, slot); an uncommitted write "poisons" every later slot of
-    # the same key via a segmented running max.
-    key_sort_hi = state.key_hi
-    key_sort_lo = state.key_lo
-    rows_w = jnp.arange(S, dtype=jnp.int32)
-    order = jnp.lexsort((rows_w, key_sort_lo, key_sort_hi))
-    s_status = state.status[order]
-    s_op = state.op[order]
-    s_key_hi = key_sort_hi[order]
-    s_key_lo = key_sort_lo[order]
-    pos = jnp.arange(S, dtype=jnp.int32)
-    seg_start = (pos == 0) | (s_key_hi != jnp.roll(s_key_hi, 1)) | (
-        s_key_lo != jnp.roll(s_key_lo, 1))
-    live = (s_status >= ACCEPTED) & (s_status < EXECUTED)
-    uncommitted_write = ((s_status == ACCEPTED)
-                         & ((s_op == int(Op.PUT))
-                            | (s_op == int(Op.DELETE))))
-    # also: ANY unexecuted write below blocks a GET; any unexecuted
-    # slot of same key blocks a WRITE (sequential-equivalence); use
-    # conservative rule: blocked if any same-key slot with smaller slot
-    # number is not yet executed and not in this step's in-order prefix
-    not_done = live & ~state.executed[order] & ~in_prefix[order]
-    poison = jnp.where(not_done | uncommitted_write, pos, -1)
-    last_poison = segmented_scan_max(poison, seg_start)
-    # slot is clear if no poison strictly before it in its key segment
-    prev_poison = jnp.where(seg_start, -1,
-                            jnp.concatenate([jnp.array([-1]),
-                                             last_poison[:-1]]))
-    clear_sorted = prev_poison < 0
-    clear = jnp.zeros(S, bool).at[order].set(clear_sorted)
-    # gap barrier: a NONE slot above the frontier has UNKNOWN future
-    # content (its key can't be consulted), so nothing beyond the first
-    # such gap may execute early — otherwise a later-committed PUT in
-    # the gap would be serialized after a GET that should have seen it
-    first_gap = jnp.min(jnp.where(
-        (idx_abs > state.committed_upto) & (state.status == NONE),
-        idx_abs, jnp.int32(2 ** 30)))
-    ooo = ((state.status == COMMITTED) & ~state.executed & ~in_prefix
-           & (idx_abs > state.committed_upto) & (idx_abs < first_gap)
-           & clear)
-    # compact: in-order prefix first (slot order), then OOO slots up to
-    # the E budget; slots already executed out-of-order must not run
-    # again when the in-order prefix sweeps past them
-    want = (in_prefix & ~state.executed) | ooo
-    exec_rank = jnp.cumsum(want.astype(jnp.int32)) - 1
-    take = want & (exec_rank < E)
-    slot_of = jnp.full(E, S, jnp.int32).at[
-        jnp.where(take, exec_rank, E)].min(idx, mode="drop")
-    evalid = slot_of < S
-    slot_of_safe = jnp.clip(slot_of, 0, S - 1)
-    op_e = jnp.where(evalid, state.op[slot_of_safe].astype(jnp.int32), 0)
-    kv, o_hi, o_lo, o_found = kv_apply_batch(
-        state.kv,
-        op_e,
-        state.key_hi[slot_of_safe],
-        state.key_lo[slot_of_safe],
-        state.val_hi[slot_of_safe],
-        state.val_lo[slot_of_safe],
-        evalid,
-    )
-    newly_exec = jnp.zeros(S, bool).at[
-        jnp.where(evalid, slot_of, S)].set(True, mode="drop")
+
+    # The whole sort/scan/KV pipeline runs under lax.cond only when a
+    # committed-unexecuted slot exists (status == COMMITTED exactly:
+    # execution moves slots to EXECUTED). Idle and accept-only ticks —
+    # most ticks of a serial op's path — skip the window lexsort and
+    # the KV probe entirely (the same gating models/minpaxos.py step 8
+    # got this round: 2.36 -> sub-1 ms idle mencius step on the host).
+    def _exec_pipeline(st):
+        # in-order part
+        avail = st.committed_upto - st.executed_upto
+        n_inorder = jnp.clip(avail, 0, E)
+        in_prefix = (idx >= rel_e0) & (idx < rel_e0 + n_inorder)
+        # out-of-order part: committed slots above the frontier with no
+        # uncommitted conflicting predecessor in the window. Sort by
+        # (key, slot); an uncommitted write "poisons" every later slot
+        # of the same key via a segmented running max.
+        rows_w = jnp.arange(S, dtype=jnp.int32)
+        order = jnp.lexsort((rows_w, st.key_lo, st.key_hi))
+        s_status = st.status[order]
+        s_op = st.op[order]
+        s_key_hi = st.key_hi[order]
+        s_key_lo = st.key_lo[order]
+        pos = jnp.arange(S, dtype=jnp.int32)
+        seg_start = (pos == 0) | (s_key_hi != jnp.roll(s_key_hi, 1)) | (
+            s_key_lo != jnp.roll(s_key_lo, 1))
+        live = (s_status >= ACCEPTED) & (s_status < EXECUTED)
+        uncommitted_write = ((s_status == ACCEPTED)
+                             & ((s_op == int(Op.PUT))
+                                | (s_op == int(Op.DELETE))))
+        # also: ANY unexecuted write below blocks a GET; any unexecuted
+        # slot of same key blocks a WRITE (sequential-equivalence); use
+        # conservative rule: blocked if any same-key slot with smaller
+        # slot number is not yet executed and not in this step's
+        # in-order prefix
+        not_done = live & ~st.executed[order] & ~in_prefix[order]
+        poison = jnp.where(not_done | uncommitted_write, pos, -1)
+        last_poison = segmented_scan_max(poison, seg_start)
+        # slot is clear if no poison strictly before it in its segment
+        prev_poison = jnp.where(seg_start, -1,
+                                jnp.concatenate([jnp.array([-1]),
+                                                 last_poison[:-1]]))
+        clear_sorted = prev_poison < 0
+        clear = jnp.zeros(S, bool).at[order].set(clear_sorted)
+        # gap barrier: a NONE slot above the frontier has UNKNOWN
+        # future content (its key can't be consulted), so nothing
+        # beyond the first such gap may execute early — otherwise a
+        # later-committed PUT in the gap would be serialized after a
+        # GET that should have seen it
+        first_gap = jnp.min(jnp.where(
+            (idx_abs > st.committed_upto) & (st.status == NONE),
+            idx_abs, jnp.int32(2 ** 30)))
+        ooo = ((st.status == COMMITTED) & ~st.executed & ~in_prefix
+               & (idx_abs > st.committed_upto) & (idx_abs < first_gap)
+               & clear)
+        # compact: in-order prefix first (slot order), then OOO slots
+        # up to the E budget; slots already executed out-of-order must
+        # not run again when the in-order prefix sweeps past them
+        want = (in_prefix & ~st.executed) | ooo
+        exec_rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+        take = want & (exec_rank < E)
+        slot_of = jnp.full(E, S, jnp.int32).at[
+            jnp.where(take, exec_rank, E)].min(idx, mode="drop")
+        evalid = slot_of < S
+        slot_of_safe = jnp.clip(slot_of, 0, S - 1)
+        op_e = jnp.where(evalid, st.op[slot_of_safe].astype(jnp.int32), 0)
+        kv, o_hi, o_lo, o_found = kv_apply_batch(
+            st.kv,
+            op_e,
+            st.key_hi[slot_of_safe],
+            st.key_lo[slot_of_safe],
+            st.val_hi[slot_of_safe],
+            st.val_lo[slot_of_safe],
+            evalid,
+        )
+        newly_exec = jnp.zeros(S, bool).at[
+            jnp.where(evalid, slot_of, S)].set(True, mode="drop")
+        return kv, newly_exec, slot_of_safe, evalid, op_e, o_hi, o_lo, o_found
+
+    def _no_exec(st):
+        z = jnp.zeros(E, jnp.int32)
+        return (st.kv, jnp.zeros(S, bool), jnp.zeros(E, jnp.int32),
+                jnp.zeros(E, bool), z, z, z, jnp.zeros(E, bool))
+
+    (kv, newly_exec, slot_of_safe, evalid, op_e, o_hi, o_lo,
+     o_found) = jax.lax.cond(
+        (state.status == COMMITTED).any(), _exec_pipeline, _no_exec, state)
     state = state._replace(
         kv=kv,
         executed=state.executed | newly_exec,

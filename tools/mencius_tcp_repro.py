@@ -1,0 +1,135 @@
+"""Repro driver: the mencius_tcp leg alone, with server stderr kept.
+
+BENCH_TCP round-5 observed trial 4 of 5 losing exactly one rr
+partition (13333/20000 acked); bench_tcp.py discards server stderr, so
+this driver re-runs just that leg with per-server log files under
+.bench_tcp_store/ to catch a fatal/fail-stop/exception on the replica.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from bench_tcp import MENCIUS_SHAPE, _warm, _progress
+from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> None:
+    q = int(os.environ.get("BENCH_TCP_Q", "20000"))
+    k = int(os.environ.get("BENCH_TCP_K", "5"))
+    extra = os.environ.get("MENCIUS_EXTRA", "").split()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    tmp = REPO / ".bench_tcp_store"
+    tmp.mkdir(exist_ok=True)
+    for f in tmp.glob("stable-store-replica*"):
+        f.unlink()
+    mport = free_ports(1)[0]
+    dports = free_ports(3, sibling_offset=CONTROL_OFFSET)
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "minpaxos_tpu.cli.master",
+         "-port", str(mport), "-N", "3"],
+        env=env, cwd=tmp, stdout=subprocess.DEVNULL,
+        stderr=open(tmp / "master.err", "w"))]
+    time.sleep(1.5)
+    for p in dports:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "minpaxos_tpu.cli.server",
+             "-m", "-durable", "-port", str(p),
+             "-mport", str(mport), *MENCIUS_SHAPE, *extra,
+             "-storedir", str(tmp)],
+            env=env, cwd=tmp, stdout=subprocess.DEVNULL,
+            stderr=open(tmp / f"server{p}.err", "w")))
+    maddr = ("127.0.0.1", mport)
+    try:
+        from minpaxos_tpu.runtime.client import MultiClient, gen_workload
+
+        _warm(maddr)
+        ops, keys, vals = gen_workload(q, seed=42)
+        import threading
+
+        for t in range(k):
+            drv = MultiClient(maddr, check=True, mode="rr")
+            stop_sampler = []
+
+            import socket
+
+            def ping(port):
+                try:
+                    with socket.create_connection(
+                            ("127.0.0.1", port + CONTROL_OFFSET),
+                            timeout=2) as s:
+                        f = s.makefile("rw")
+                        f.write(json.dumps({"m": "ping"}) + "\n")
+                        f.flush()
+                        return json.loads(f.readline())
+                except OSError:
+                    return {}
+
+            def sample():
+                t00 = time.perf_counter()
+                last = 0
+                while not stop_sampler:
+                    time.sleep(5.0)
+                    now = sum(len(c.replies) for c in drv.clients)
+                    views = []
+                    for p in dports:
+                        r = ping(p)
+                        st = r.get("stats", {})
+                        views.append(
+                            f"f={r.get('frontier')} c={r.get('crt_inst')}"
+                            f" t={st.get('ticks')} x={st.get('executed')}")
+                    _progress(f"  +{time.perf_counter()-t00:5.0f}s "
+                              f"acked={now} (+{now-last}) | "
+                              + " | ".join(views))
+                    last = now
+
+            smp = threading.Thread(target=sample, daemon=True)
+            smp.start()
+            try:
+                t0 = time.perf_counter()
+                stats = drv.run_workload(ops, keys, vals, timeout_s=120)
+                wall = time.perf_counter() - t0
+                stop_sampler.append(1)
+            finally:
+                try:
+                    drv.close()
+                except Exception:
+                    pass
+            _progress(f"trial {t}: {stats['acked']}/{q} acked, "
+                      f"{round(stats['acked']/wall, 1)} ops/s, "
+                      f"missing={stats.get('missing')}")
+            if stats["acked"] != q:
+                _progress(f"FAILURE at trial {t}: {stats}")
+                break
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        time.sleep(1.0)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    for f in sorted(tmp.glob("*.err")):
+        tail = f.read_text()[-2000:]
+        if tail.strip():
+            print(f"==== {f.name} ====\n{tail}")
+
+
+if __name__ == "__main__":
+    main()
