@@ -1,0 +1,49 @@
+"""Wait for the TPU relay, then run the rung-0 bench child (A/B of the
+winner-gather rewrite against the 673.9 ms/round pre-rewrite record).
+
+Probes the backend on the shared playbook's cadence indefinitely (the
+relay outage window has been hours); on the first live non-cpu answer,
+runs ``MP_BENCH_CHILD=64,2048,256,16 python bench.py`` and writes the
+record to .bench_tpu_r5_rung0_postwinner.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from minpaxos_tpu.utils.backend import probe_backend
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> None:
+    t0 = time.monotonic()
+    while True:
+        platform = probe_backend(timeout_s=120)
+        waited = time.monotonic() - t0
+        print(f"[ab-waiter] +{waited:6.0f}s probe -> {platform}",
+              file=sys.stderr, flush=True)
+        if platform and platform != "cpu":
+            break
+        time.sleep(120)
+    env = dict(os.environ, MP_BENCH_CHILD="64,2048,256,16",
+               MP_BENCH_PROBED="1")
+    proc = subprocess.run([sys.executable, str(REPO / "bench.py")],
+                          env=env, stdout=subprocess.PIPE, timeout=2400)
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.strip().startswith("{")]
+    out = REPO / ".bench_tpu_r5_rung0_postwinner.json"
+    out.write_text((lines[-1] + "\n") if lines else
+                   json.dumps({"error": f"child rc={proc.returncode}"}))
+    print(f"[ab-waiter] wrote {out}", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()
