@@ -35,11 +35,12 @@ import numpy as np
 from minpaxos_tpu.models.minpaxos import (
     ACCEPTED,
     COMMITTED,
+    ExecResult,
     MinPaxosConfig,
     MsgBatch,
     become_leader,
     init_replica,
-    replica_step,
+    replica_step_impl,
 )
 from minpaxos_tpu.ops.packed import join_i64, split_i64
 from minpaxos_tpu.runtime import batches
@@ -56,6 +57,42 @@ from minpaxos_tpu.utils.netutil import CONTROL_OFFSET
 from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
 
 CONTROL = 3  # queue item source tag (transport uses 0..2)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+def _packed_step(cfg, state, inbox, step_impl):
+    """Protocol step + device-side packing of everything the host reads
+    per tick into THREE arrays: the per-tick host cost used to be ~30
+    per-column/per-scalar ``np.asarray`` device reads (~1 s of the
+    leader's CPU over a 50k-op run, tools/profile_tcp_leader.py); one
+    [14, M] outbox matrix, one [6, E] exec matrix and one [8] scalar
+    vector make it three transfers. Module-level jit: every replica in
+    the process shares one compile cache (see ReplicaServer.step note).
+    """
+    state, outbox, execr = step_impl(cfg, state, inbox)
+    m = outbox.msgs
+    # acked is the per-INBOX-row mask ([rows in] <= [rows out] after
+    # the kernel appends its sweep/retry/catch-up rows); zero-pad it
+    # to the outbox length so one matrix carries everything
+    ack = outbox.acked.astype(jnp.int32)
+    ack = jnp.pad(ack, (0, m.kind.shape[0] - ack.shape[0]))
+    out_mat = jnp.stack(
+        [getattr(m, c).astype(jnp.int32) for c in MsgBatch._fields]
+        + [outbox.dst.astype(jnp.int32), ack])
+    exec_mat = jnp.stack([
+        execr.val_hi.astype(jnp.int32), execr.val_lo.astype(jnp.int32),
+        execr.found.astype(jnp.int32), execr.op.astype(jnp.int32),
+        execr.cmd_id.astype(jnp.int32), execr.client_id.astype(jnp.int32)])
+    leader = getattr(state, "leader_id", None)
+    prepared = getattr(state, "prepared", None)
+    scal = jnp.stack([
+        state.committed_upto, state.window_base, state.crt_inst,
+        state.kv.dropped.astype(jnp.int32),
+        execr.lo.astype(jnp.int32), execr.count.astype(jnp.int32),
+        jnp.int32(-1) if leader is None else leader.astype(jnp.int32),
+        jnp.int32(1) if prepared is None else prepared.astype(jnp.int32),
+    ])
+    return state, out_mat, exec_mat, scal
 
 
 class FatalReplicaError(RuntimeError):
@@ -119,19 +156,22 @@ class ReplicaServer:
         if protocol == "mencius":
             from minpaxos_tpu.models.mencius import (
                 init_mencius,
-                mencius_step,
+                mencius_step_impl,
             )
 
-            step_fn, init_fn = mencius_step, init_mencius
+            step_impl, init_fn = mencius_step_impl, init_mencius
         else:
-            step_fn, init_fn = replica_step, init_replica
+            step_impl, init_fn = replica_step_impl, init_replica
         self.transport = Transport(me, addrs)
         self.queue = self.transport.queue
-        # the MODULE-level jitted step (static cfg): every replica in
-        # the process shares ONE compile cache — N private jax.jit
-        # wrappers would compile the same kernel N times concurrently,
-        # which starves small hosts (in-process test clusters)
-        self.step = functools.partial(step_fn, self.cfg)
+        # the MODULE-level jitted packed step (static cfg + impl):
+        # every replica in the process shares ONE compile cache — N
+        # private jax.jit wrappers would compile the same kernel N
+        # times concurrently, which starves small hosts (in-process
+        # test clusters)
+        cfg_ = self.cfg
+        self.step = lambda state, inbox: _packed_step(
+            cfg_, state, inbox, step_impl)
         # copy every leaf: jax caches/aliases equal small constants, and
         # donation rejects the same buffer appearing twice
         self.state = jax.tree_util.tree_map(
@@ -603,25 +643,56 @@ class ReplicaServer:
         t0 = time.perf_counter() if DLOG else 0.0
         cols, n_rows = buf.drain()
         inbox = MsgBatch(**{c: np.asarray(cols[c]) for c in batches.COLS})
-        self.state, outbox, execr = self.step(self.state, inbox)
-        out_cols = {c: np.asarray(getattr(outbox.msgs, c))
-                    for c in batches.COLS}
-        dst = np.asarray(outbox.dst)
+        # THREE device reads per tick (outbox matrix, exec matrix,
+        # scalar vector) — see _packed_step
+        self.state, out_mat_d, exec_mat_d, scal_d = self.step(
+            self.state, inbox)
+        out_mat = np.asarray(out_mat_d)
+        exec_mat = np.asarray(exec_mat_d)
+        scal = np.asarray(scal_d)
+        out_cols = {c: out_mat[i] for i, c in enumerate(batches.COLS)}
+        dst = out_mat[len(batches.COLS)]
+        acked = out_mat[len(batches.COLS) + 1].astype(bool)
+        frontier = int(scal[0])
+        execr = ExecResult(
+            lo=int(scal[4]), count=int(scal[5]),
+            val_hi=exec_mat[0], val_lo=exec_mat[1],
+            found=exec_mat[2].astype(bool), op=exec_mat[3],
+            cmd_id=exec_mat[4], client_id=exec_mat[5])
         if DLOG and n_rows:
             dlog(f"replica {self.me}: step+convert "
                  f"{(time.perf_counter() - t0) * 1e3:.2f}ms")
+        mencius = self.protocol == "mencius"
+        if frontier < self.snapshot["frontier"]:
+            # the commit frontier is monotonic by construction; going
+            # backward means device state was rebuilt/corrupted — make
+            # that loudly visible (it presents as a silent wedge)
+            dlog(f"replica {self.me}: FRONTIER WENT BACKWARD "
+                 f"{self.snapshot['frontier']} -> {frontier}")
+        # published BEFORE dispatch so _host_catchup (and the control
+        # plane) read this tick's values from the snapshot instead of
+        # issuing fresh per-field device reads
+        self.snapshot = {
+            "frontier": frontier,
+            "window_base": int(scal[1]),
+            "crt_inst": int(scal[2]),
+            # mencius is leaderless: leader=-1 hints clients any
+            # replica serves; prepared=True keeps the re-prepare
+            # wedge-guard inert
+            "leader": -1 if mencius else int(scal[6]),
+            "prepared": True if mencius else bool(scal[7]),
+        }
         if persist:
             # always maintained (in-memory mirror feeds beyond-window
             # catch-up); -durable additionally fsyncs before replies
-            self._persist(cols, n_rows, out_cols,
-                          np.asarray(outbox.acked))
+            self._persist(cols, n_rows, out_cols, acked)
         if dispatch:
             self._dispatch(out_cols, dst)
-            self._reply(execr, out_cols, dst)
+            self._reply(execr, frontier)
             self._host_catchup()
             self.transport.flush_all()
         self._idle = (n_rows == 0 and not (out_cols["kind"] != 0).any()
-                      and int(np.asarray(execr.count)) == 0)
+                      and execr.count == 0)
         # KV saturation is a correctness failure, not a statistic: a
         # dropped insert belongs to a command that was (or will be)
         # acked, so the state machine silently diverges from the log.
@@ -629,32 +700,13 @@ class ReplicaServer:
         # a fixed-capacity table must fail-stop instead of serving
         # wrong data. Checked every tick (one scalar read alongside
         # the snapshot reads below).
-        dropped = int(np.asarray(self.state.kv.dropped))
+        dropped = int(scal[3])
         if dropped and self.fatal is None:
             self.fatal = (
                 f"replica {self.me}: KV table saturated — {dropped} "
                 f"write(s) dropped (kv_pow2={self.cfg.kv_pow2} is too "
                 f"small for the live key space); failing stop")
             raise FatalReplicaError(self.fatal)
-        mencius = self.protocol == "mencius"
-        frontier = int(np.asarray(self.state.committed_upto))
-        if frontier < self.snapshot["frontier"]:
-            # the commit frontier is monotonic by construction; going
-            # backward means device state was rebuilt/corrupted — make
-            # that loudly visible (it presents as a silent wedge)
-            dlog(f"replica {self.me}: FRONTIER WENT BACKWARD "
-                 f"{self.snapshot['frontier']} -> {frontier}")
-        self.snapshot = {
-            "frontier": frontier,
-            "window_base": int(np.asarray(self.state.window_base)),
-            "crt_inst": int(np.asarray(self.state.crt_inst)),
-            # mencius is leaderless: leader=-1 hints clients any
-            # replica serves; prepared=True keeps the re-prepare
-            # wedge-guard inert
-            "leader": -1 if mencius else int(np.asarray(self.state.leader_id)),
-            "prepared": (True if mencius
-                         else bool(np.asarray(self.state.prepared))),
-        }
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
 
@@ -825,18 +877,17 @@ class ReplicaServer:
 
     # -- execution replies (ReplyProposeTS, genericsmr.go:529) --
 
-    def _reply(self, execr, out_cols, dst) -> None:
-        n = int(np.asarray(execr.count))
+    def _reply(self, execr, frontier: int) -> None:
+        n = execr.count
         self.stats["executed"] += n
-        self.stats["committed"] = int(np.asarray(self.state.committed_upto)) + 1
+        self.stats["committed"] = frontier + 1
         if n == 0 or not self.flags.dreply:
             return
         if DLOG:
             dlog(f"replica {self.me}: reply n={n}")
-        cids = np.asarray(execr.client_id)[:n]
-        cmds = np.asarray(execr.cmd_id)[:n]
-        vals = join_i64(np.asarray(execr.val_hi)[:n],
-                        np.asarray(execr.val_lo)[:n])
+        cids = execr.client_id[:n]
+        cmds = execr.cmd_id[:n]
+        vals = join_i64(execr.val_hi[:n], execr.val_lo[:n])
         # group-by client connection: ONE frame (and one socket write)
         # per (conn, kind) instead of a frame per executed command —
         # the reply path must stay invisible next to the device step
@@ -878,12 +929,14 @@ class ReplicaServer:
             # (kernel) plus peers' store-served COMMIT answers to
             # beyond-window PREPARE_INSTs (_mencius_store_answer).
             return
-        if not bool(np.asarray(self.state.prepared)):
+        # this tick's values, published by _device_tick just above —
+        # no per-field device reads on the hot path (the packed-step
+        # point); only peer_commits is read, and only on the leader
+        snap = self.snapshot
+        if not snap["prepared"] or snap["leader"] != self.me:
             return
-        if int(np.asarray(self.state.leader_id)) != self.me:
-            return
-        base = int(np.asarray(self.state.window_base))
-        fr = int(np.asarray(self.state.committed_upto))
+        base = snap["window_base"]
+        fr = snap["frontier"]
         pc = np.asarray(self.state.peer_commits)
         for q in range(self.cfg.n_replicas):
             if q == self.me or pc[q] + 1 >= base:

@@ -1,0 +1,81 @@
+"""Where does a TCP leader's wall time go under closed-loop load?
+
+Boots an in-process 3-replica MinPaxos cluster (the test-harness
+deployment), drives q ops through the real wire path, and prints the
+leader's protocol-thread cProfile (cumulative top-25). In-process on a
+1-core host overstates contention, but the RELATIVE split between
+device dispatch, codec, socket IO and bookkeeping is what we're after.
+
+Run: python tools/profile_tcp_leader.py [q]
+"""
+
+from __future__ import annotations
+
+import cProfile
+import os
+import pathlib
+import pstats
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    q = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+    from minpaxos_tpu.runtime.client import Client, gen_workload
+    from minpaxos_tpu.runtime.master import Master, register_with_master
+    from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
+    from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
+
+    tmp = tempfile.mkdtemp(prefix="prof_tcp_")
+    mport = free_ports(1)[0]
+    dports = free_ports(3, sibling_offset=CONTROL_OFFSET)
+    master = Master("127.0.0.1", mport, 3)
+    master.start()
+    for p in dports:
+        register_with_master(("127.0.0.1", mport), "127.0.0.1", p)
+    cfg = MinPaxosConfig(n_replicas=3, window=2048, inbox=1024,
+                         exec_batch=128, kv_pow2=18,
+                         catchup_rows=256, recovery_rows=256)
+    prof = cProfile.Profile()
+    servers = []
+    for i, p in enumerate(dports):
+        flags = RuntimeFlags(durable=True, store_dir=tmp,
+                             profile=prof if i == 0 else None)
+        s = ReplicaServer(i, [("127.0.0.1", pp) for pp in dports],
+                          cfg, flags)
+        s.start()
+        servers.append(s)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if servers[0].snapshot["prepared"]:
+            break
+        time.sleep(0.1)
+
+    cli = Client(("127.0.0.1", mport), check=True)
+    ops, keys, vals = gen_workload(q, seed=9)
+    t0 = time.perf_counter()
+    stats = cli.run_workload(ops, keys, vals, timeout_s=180)
+    wall = time.perf_counter() - t0
+    print(f"acked {stats['acked']}/{q} in {wall:.2f}s "
+          f"({stats['acked']/wall:.0f} ops/s)", file=sys.stderr)
+    cli.close_conn()
+    for s in servers:
+        s.stop()
+    master.stop()
+
+    ps = pstats.Stats(prof)
+    ps.sort_stats("cumulative")
+    ps.print_stats(25)
+    ps.sort_stats("tottime")
+    ps.print_stats(30)
+
+
+if __name__ == "__main__":
+    main()
