@@ -111,6 +111,11 @@ def cluster_step_impl(
     models/mencius.py's mencius_step_impl. The routing fabric is
     protocol-agnostic — it only reads the Outbox.
     """
+    # every pod/sharded composition vmaps the replica step, where a
+    # gated exec (lax.cond) lowers to select and runs both branches —
+    # strip the gate at this choke point so callers don't each have to
+    # remember to pass gate_exec=False
+    cfg = cfg._replace(gate_exec=False)
     inbox = _concat_rows(cs.pending, ext)
     # dead replicas see silence
     inbox = inbox._replace(

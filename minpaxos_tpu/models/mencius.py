@@ -861,9 +861,14 @@ def mencius_step_impl(
         return (st.kv, jnp.zeros(S, bool), jnp.zeros(E, jnp.int32),
                 jnp.zeros(E, bool), z, z, z, jnp.zeros(E, bool))
 
-    (kv, newly_exec, slot_of_safe, evalid, op_e, o_hi, o_lo,
-     o_found) = jax.lax.cond(
-        (state.status == COMMITTED).any(), _exec_pipeline, _no_exec, state)
+    if cfg.gate_exec:
+        (kv, newly_exec, slot_of_safe, evalid, op_e, o_hi, o_lo,
+         o_found) = jax.lax.cond(
+            (state.status == COMMITTED).any(), _exec_pipeline, _no_exec,
+            state)
+    else:  # vmapped composition: cond would run both branches anyway
+        (kv, newly_exec, slot_of_safe, evalid, op_e, o_hi, o_lo,
+         o_found) = _exec_pipeline(state)
     state = state._replace(
         kv=kv,
         executed=state.executed | newly_exec,
