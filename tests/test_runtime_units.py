@@ -146,3 +146,92 @@ def test_stable_store_torn_tail(tmp_path):
     assert r.committed_prefix() == 2
     assert len(r.read_range(0, 10)) == 3
     r.close()
+
+
+def test_packed_step_layout_matches_cols():
+    """_packed_step's outbox matrix rows must follow batches.COLS order
+    (+ dst, + padded acked) — _device_tick unpacks positionally."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from minpaxos_tpu.models.minpaxos import (
+        MinPaxosConfig,
+        MsgBatch,
+        init_replica,
+        replica_step_impl,
+    )
+    from minpaxos_tpu.runtime import batches
+    from minpaxos_tpu.runtime.replica import _packed_step
+    from minpaxos_tpu.wire.messages import MsgKind, Op
+
+    assert MsgBatch._fields == batches.COLS
+    cfg = MinPaxosConfig(n_replicas=3, window=64, inbox=16, exec_batch=8,
+                         kv_pow2=6, catchup_rows=4, recovery_rows=4)
+    st = init_replica(cfg, 0)
+    from minpaxos_tpu.models.minpaxos import become_leader
+    st, _ = become_leader(cfg, st)
+    # donation rejects aliased leaves (init shares zero buffers), same
+    # copy ReplicaServer.__init__ performs
+    import jax
+    st = jax.tree_util.tree_map(lambda x: x.copy(), st)
+    row = {c: np.zeros(16, np.int32) for c in batches.COLS}
+    row["kind"][0] = int(MsgKind.PROPOSE)
+    row["src"][0] = -1
+    row["op"][0] = int(Op.PUT)
+    row["key_lo"][0] = 7
+    row["val_lo"][0] = 9
+    row["cmd_id"][0] = 3
+    inbox = MsgBatch(**{k: jnp.asarray(v) for k, v in row.items()})
+    st2, out_mat, exec_mat, scal = _packed_step(
+        cfg, st, inbox, replica_step_impl)
+    out_mat = np.asarray(out_mat)
+    ncols = len(batches.COLS)
+    assert out_mat.shape[0] == ncols + 2
+    cols = {c: out_mat[i] for i, c in enumerate(batches.COLS)}
+    # a 1-of-3 leader is not yet prepared (needs a majority of
+    # PREPARE_REPLYs), so the propose bounces as a client-bound
+    # rejection that still carries the command columns — exactly the
+    # layout the unpack depends on
+    rej = cols["kind"] == int(MsgKind.PROPOSE_REPLY)
+    assert rej.any()
+    i = int(np.argmax(rej))
+    assert cols["key_lo"][i] == 7 and cols["val_lo"][i] == 9
+    assert cols["cmd_id"][i] == 3
+    dst = out_mat[ncols]
+    assert dst[i] == -2  # client-bound
+    # scal layout: frontier, window_base, crt_inst, dropped, lo, count,
+    # leader, prepared
+    scal = np.asarray(scal)
+    assert scal.shape == (8,)
+    assert scal[0] == -1 and scal[1] == 0  # nothing committed yet
+    assert scal[6] == 0 and scal[7] == 0  # leader 0, not yet prepared
+
+
+def test_cluster_step_strips_exec_gate():
+    """Vmapped compositions must run ungated exec (cond-under-vmap
+    evaluates both branches): cluster_step_impl rewrites the static
+    config before tracing the per-replica step."""
+    from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+
+    seen = []
+
+    def spy_step(cfg, state, inbox):
+        seen.append(cfg.gate_exec)
+        from minpaxos_tpu.models.minpaxos import replica_step_impl
+        return replica_step_impl(cfg, state, inbox)
+
+    cfg = MinPaxosConfig(n_replicas=3, window=32, inbox=8, exec_batch=4,
+                         kv_pow2=6, catchup_rows=4, recovery_rows=4)
+    assert cfg.gate_exec  # default on (the TCP runtime's fast path)
+    import jax
+    import jax.numpy as jnp
+
+    from minpaxos_tpu.models.cluster import Cluster, cluster_step_impl
+    from minpaxos_tpu.models.minpaxos import MsgBatch
+
+    cs = Cluster(cfg).cs  # the real pod-mode construction
+    ext = jax.tree_util.tree_map(
+        lambda x: jnp.zeros((3,) + x.shape, x.dtype),
+        MsgBatch.empty(4))
+    cluster_step_impl(cfg, cs, ext, step_impl=spy_step)
+    assert seen and not any(seen)
