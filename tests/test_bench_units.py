@@ -203,3 +203,44 @@ def test_salvage_partial_prefers_last_parseable_tpu_record():
     # error records are not salvageable
     assert bench.salvage_partial(
         b'{"value": 0.0, "platform": "tpu", "error": "boom"}') is None
+
+
+def test_ladder_merges_first_rung_fault_leg(monkeypatch):
+    """Bigger rungs skip kill/recover (worker-crash risk); the ladder
+    must carry rung 0's measured leg into the winning record."""
+    import json
+    import subprocess
+    import types
+
+    import bench
+
+    recs = [
+        {"value": 100.0, "platform": "tpu",
+         "kill_recover": {"victim": 2, "dip_pct": 1.0}},
+        {"value": 200.0, "platform": "tpu",
+         "kill_recover": {"skipped": "first rung only"}},
+        {"value": 300.0, "platform": "tpu",
+         "kill_recover": {"skipped": "first rung only"}},
+    ]
+    calls = []
+
+    def fake_run(cmd, env=None, stdout=None, timeout=None):
+        i = len(calls)
+        calls.append(env.get("MP_BENCH_FAULT"))
+        return types.SimpleNamespace(
+            returncode=0, stdout=(json.dumps(recs[i]) + "\n").encode())
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_wait_for_backend", lambda **kw: "tpu")
+    out = []
+    monkeypatch.setattr("builtins.print", lambda *a, **kw: out.append(a))
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.delenv("MP_BENCH_CHILD", raising=False)
+    bench.main()
+    # fault leg requested only at rung 0
+    assert calls == ["1", "0", "0"]
+    final = json.loads(out[-1][0])
+    assert final["value"] == 300.0  # biggest rung wins
+    # ...but carries rung 0's measured kill/recover
+    assert final["kill_recover"]["victim"] == 2
+    assert final["kill_recover"]["measured_at_shape"] == [64, 2048, 256, 16]
