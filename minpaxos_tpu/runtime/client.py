@@ -120,22 +120,37 @@ class Client:
     def _on_frame(self, kind: MsgKind, rows: np.ndarray) -> None:
         if kind not in (MsgKind.PROPOSE_REPLY, MsgKind.READ_REPLY):
             return
+        # t_arrive: reader-thread arrival time (one stamp per frame —
+        # the rows arrived together), for the open-loop latency probe
+        t = time.monotonic()
         with self._got:
-            for r in rows:
-                cmd = int(r["cmd_id"])
-                if kind == MsgKind.PROPOSE_REPLY and not r["ok"]:
-                    self.leader_hint = int(r["leader"])
-                    self.rejected.append(cmd)
-                    continue
-                if cmd in self.replies:
-                    self.dup_replies += 1  # -check duplicate detection
-                    continue
-                # t_arrive: exact reader-thread arrival time, for the
-                # open-loop latency probe (a poller would quantize)
-                entry = {"val": int(r["val"]), "t_arrive": time.monotonic()}
-                if kind == MsgKind.PROPOSE_REPLY:
-                    entry["ts"] = int(r["timestamp"])
-                self.replies[cmd] = entry
+            # column extraction + zip over plain Python scalars: per-row
+            # structured access (r["field"]) cost ~0.8 ms per 512-row
+            # frame of pure client CPU on the shared bench core
+            if kind == MsgKind.PROPOSE_REPLY:
+                okm = rows["ok"] != 0
+                rej = rows[~okm]
+                if len(rej):
+                    self.leader_hint = int(rej["leader"][-1])
+                    self.rejected.extend(rej["cmd_id"].tolist())
+                    rows = rows[okm]
+                replies = self.replies
+                for cmd, val, ts in zip(rows["cmd_id"].tolist(),
+                                        rows["val"].tolist(),
+                                        rows["timestamp"].tolist()):
+                    if cmd in replies:
+                        self.dup_replies += 1  # -check duplicates
+                    else:
+                        replies[cmd] = {"val": val, "t_arrive": t,
+                                        "ts": ts}
+            else:
+                replies = self.replies
+                for cmd, val in zip(rows["cmd_id"].tolist(),
+                                    rows["val"].tolist()):
+                    if cmd in replies:
+                        self.dup_replies += 1
+                    else:
+                        replies[cmd] = {"val": val, "t_arrive": t}
             self._got.notify_all()
 
     # -- propose / wait --
