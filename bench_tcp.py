@@ -194,6 +194,12 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
                    if multi_rr else Client(maddr, check=True))
             try:
                 t0 = time.perf_counter()
+                # batch 512 on purpose: 1024 (== SERVER_SHAPE's inbox)
+                # measured +14% in-process but went bimodal against
+                # real processes — proposals plus ack/catch-up traffic
+                # share the inbox, and any overflow drop costs a 3 s
+                # retry timeout (subprocess trials split 13.9k best /
+                # 2.5k worst); 2048 collapsed outright (12.2k -> 0.7k)
                 stats = drv.run_workload(ops, keys, vals, timeout_s=120)
                 wall = time.perf_counter() - t0
             finally:

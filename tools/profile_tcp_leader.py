@@ -61,7 +61,9 @@ def main() -> None:
     cli = Client(("127.0.0.1", mport), check=True)
     ops, keys, vals = gen_workload(q, seed=9)
     t0 = time.perf_counter()
-    stats = cli.run_workload(ops, keys, vals, timeout_s=180)
+    stats = cli.run_workload(
+        ops, keys, vals, timeout_s=180,
+        batch=int(os.environ.get("PROF_BATCH", "512")))
     wall = time.perf_counter() - t0
     print(f"acked {stats['acked']}/{q} in {wall:.2f}s "
           f"({stats['acked']/wall:.0f} ops/s)", file=sys.stderr)
