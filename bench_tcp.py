@@ -200,7 +200,8 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
                 # share the inbox, and any overflow drop costs a 3 s
                 # retry timeout (subprocess trials split 13.9k best /
                 # 2.5k worst); 2048 collapsed outright (12.2k -> 0.7k)
-                stats = drv.run_workload(ops, keys, vals, timeout_s=120)
+                stats = drv.run_workload(ops, keys, vals, timeout_s=120,
+                                         batch=512)
                 wall = time.perf_counter() - t0
             finally:
                 try:

@@ -96,6 +96,12 @@ def main(argv=None) -> None:
     import jax
 
     jax.config.update("jax_platforms", args.platform)
+    # shared persistent compile cache: without it every server process
+    # re-jits identical kernels at boot (~10-40 s each, and concurrent
+    # first boots starve each other on small hosts — utils/backend.py)
+    from minpaxos_tpu.utils.backend import enable_compile_cache
+
+    enable_compile_cache()
 
     from minpaxos_tpu.models.minpaxos import MinPaxosConfig
     from minpaxos_tpu.runtime.master import get_replica_list, register_with_master

@@ -81,6 +81,37 @@ def wait_for_backend(attempts: int = 5, probe_timeout_s: float = 120.0,
     return None
 
 
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at a shared repo-local
+    directory (opt out with MINPAXOS_NO_COMPILE_CACHE=1).
+
+    Why this exists: every replica server process jit-compiles the same
+    protocol kernels from scratch (~10-40 s on the 1-core host). Three
+    servers compiling concurrently at boot starved each other so badly
+    that one replica could sit wedged in compilation for an entire
+    serial bench run (round-5 dlog timeline: replica 0 ticked ONCE in
+    30 s while its peers re-dialed it every second), and warmup
+    intermittently failed outright. With the cache, repeat boots load
+    in ~1 s. Must run BEFORE the first jax compile; safe to call twice.
+    """
+    if os.environ.get("MINPAXOS_NO_COMPILE_CACHE", "0") not in (
+            "", "0", "false", "False"):
+        return
+    import pathlib
+
+    import jax
+
+    d = cache_dir or str(pathlib.Path(__file__).resolve().parents[2]
+                         / ".jax_cache")
+    try:
+        pathlib.Path(d).mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
+
+
 def init_backend(retries: int = 2, timeout_s: float = 120.0,
                  progress=None, on_fail=None):
     """Initialize a JAX backend defensively; returns jax.devices().

@@ -24,3 +24,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# shared persistent compile cache (repo-local .jax_cache): the suite
+# boots many real server processes that would otherwise each re-jit
+# identical kernels for seconds on the 1-core CI host
+from minpaxos_tpu.utils.backend import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

@@ -29,6 +29,9 @@ def main() -> None:
     q = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
     from minpaxos_tpu.models.minpaxos import MinPaxosConfig
     from minpaxos_tpu.runtime.client import Client, gen_workload
+    from minpaxos_tpu.utils.backend import enable_compile_cache
+
+    enable_compile_cache()  # keep first-boot re-jits out of the profile
     from minpaxos_tpu.runtime.master import Master, register_with_master
     from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
     from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
