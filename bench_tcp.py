@@ -71,6 +71,25 @@ MENCIUS_SHAPE = ["-window", "4096", "-inbox", "2048", "-kvpow2", "18",
 SERIAL_SHAPE = ["-window", "512", "-inbox", "256", "-kvpow2", "12",
                 "-execbatch", "64"]
 
+# Round-6 runtime knobs (fused burst ticks / idle fast path / narrow
+# view — runtime/replica.py RuntimeFlags), env-overridable for A/B
+# runs; every record carries the values used so a number can never be
+# misread as measured under different knobs.
+RUNTIME_KNOBS = {
+    "fuse_ticks": os.environ.get("BENCH_TCP_FUSE", "3"),
+    "idle_fastpath": os.environ.get("BENCH_TCP_IDLEFAST", "1") != "0",
+    "narrow_window": os.environ.get("BENCH_TCP_NARROW", "0"),
+}
+
+
+def _knob_args(keyhint: int) -> list:
+    args = ["-fuseticks", RUNTIME_KNOBS["fuse_ticks"],
+            "-narrow", RUNTIME_KNOBS["narrow_window"],
+            "-keyhint", str(keyhint)]
+    if not RUNTIME_KNOBS["idle_fastpath"]:
+        args.append("-noidlefast")
+    return args
+
 
 def _progress(msg: str) -> None:
     print(f"[bench_tcp] {msg}", file=sys.stderr, flush=True)
@@ -100,17 +119,19 @@ import contextlib
 
 
 @contextlib.contextmanager
-def _cluster(proto_flag: str, shape):
+def _cluster(proto_flag: str, shape, keyhint: int = 100000):
     """Boot master + 3 servers with a fresh store dir; yield the master
     address; tear everything down (SIGTERM, then kill) and wipe the
     stores on exit — the one copy of the lifecycle both the throughput
-    and serial legs use."""
+    and serial legs use. ``keyhint``: the workload's distinct-key
+    count, forwarded so servers log projected KV load at boot."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
     tmp = REPO / ".bench_tcp_store"
     tmp.mkdir(exist_ok=True)
     for f in tmp.glob("stable-store-replica*"):
         f.unlink()
-    procs, mport = _boot(proto_flag, env, tmp, shape)
+    procs, mport = _boot(proto_flag, env, tmp,
+                         list(shape) + _knob_args(keyhint))
     try:
         yield ("127.0.0.1", mport)
     finally:
@@ -230,6 +251,7 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
             "check": ("ok" if all(s == "ok" for s in trial_stats)
                       else trial_stats),
             "server_shape": " ".join(shape),
+            "runtime_knobs": dict(RUNTIME_KNOBS),
             "reference_shape": ref_shape,
         }
 
@@ -239,7 +261,7 @@ def run_serial(proto_flag: str, label: str) -> dict:
     one-at-a-time ops with UNIQUE cmd_ids (clientlat shape,
     clientlat/client.go:134-160), failover-robust (a rejection or dead
     socket re-routes instead of crashing the record)."""
-    with _cluster(proto_flag, SERIAL_SHAPE) as maddr:
+    with _cluster(proto_flag, SERIAL_SHAPE, keyhint=520) as maddr:
         from minpaxos_tpu.cli.client import _propose_until_acked
         from minpaxos_tpu.runtime.client import Client
 
@@ -264,6 +286,7 @@ def run_serial(proto_flag: str, label: str) -> dict:
             if lats else None,
             "n_serial": len(lats),
             "serial_shape": " ".join(SERIAL_SHAPE),
+            "runtime_knobs": dict(RUNTIME_KNOBS),
         }
 
 

@@ -79,6 +79,27 @@ def main(argv=None) -> None:
                         " immediate); >1 suppresses the per-commit"
                         " wakeup cascade on small hosts at the cost of"
                         " idle followers executing a few ticks late")
+    p.add_argument("-fuseticks", type=int, default=3,
+                   help="fused protocol substeps per device dispatch"
+                        " when the batch will need follow-up ticks"
+                        " (exec backlog / lagging catch-up cursors);"
+                        " 1 disables fusion")
+    p.add_argument("-noidlefast", action="store_true",
+                   help="disable the idle fast path (a quiet replica"
+                        " then pays a full device dispatch per idle"
+                        " poll, the pre-round-6 behavior)")
+    p.add_argument("-idlemaxskip", type=float, default=0.25,
+                   help="idle fast path safety net: force one real"
+                        " device tick at least this often (seconds)")
+    p.add_argument("-narrow", type=int, default=0,
+                   help="small-window specialized step: run"
+                        " low-occupancy ticks through a compiled-once"
+                        " resident view of this many slots (0 = off;"
+                        " try 512 on servers sized -window >= 4096)")
+    p.add_argument("-keyhint", type=int, default=0,
+                   help="expected distinct keys in the workload; the"
+                        " server logs projected KV load vs -kvpow2"
+                        " capacity at startup (saturation fail-stops)")
     p.add_argument("-storedir", default=".",
                    help="stable store directory")
     p.add_argument("-platform", default="cpu",
@@ -134,6 +155,12 @@ def main(argv=None) -> None:
     flags = RuntimeFlags(dreply=args.dreply,
                          durable=args.durable, thrifty=args.thrifty,
                          beacon=args.beacon, store_dir=args.storedir,
+                         fuse_ticks=args.fuseticks,
+                         idle_fastpath=not args.noidlefast,
+                         idle_skip_max_s=args.idlemaxskip,
+                         narrow_window=args.narrow,
+                         key_hint=args.keyhint,
+                         warm_variants=True,
                          profile=prof)
     server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags,
                            protocol=protocol)

@@ -149,10 +149,16 @@ def init_mencius(cfg: MinPaxosConfig, me: int) -> MenciusState:
 
 
 def mencius_step_impl(
-    cfg: MinPaxosConfig, state: MenciusState, inbox: MsgBatch
+    cfg: MinPaxosConfig, state: MenciusState, inbox: MsgBatch,
+    tick_inc=1,
 ) -> tuple[MenciusState, Outbox, ExecResult]:
     """Advance one Mencius replica by one message batch (pure; vmapped
-    by the cluster wrapper below)."""
+    by the cluster wrapper below).
+
+    ``tick_inc``: wall ticks this step represents (0 for the trailing
+    substeps of a fused burst — see models/minpaxos.py
+    replica_step_impl); keeps the stall/takeover counters wall-honest
+    under the TCP runtime's multi-substep dispatches."""
     S, R = cfg.window, cfg.n_replicas
     M = inbox.kind.shape[0]
     majority = cfg.majority
@@ -512,9 +518,9 @@ def mencius_step_impl(
     advanced = state.committed_upto > old_upto
     in_flight = state.crt_inst - 1 > state.committed_upto
     state = state._replace(
-        tick=state.tick + 1,
+        tick=state.tick + tick_inc,
         stall_ticks=jnp.where(in_flight & ~advanced,
-                              state.stall_ticks + 1, 0))
+                              state.stall_ticks + tick_inc, 0))
 
     # ---- 9. chunked COMMIT broadcast for my newly committed slots ----
     # Strides over MY OWN slots (me, me+R, ...): a window over raw log

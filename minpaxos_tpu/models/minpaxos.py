@@ -333,13 +333,23 @@ def _rel(state: ReplicaState, inst, window: int):
 
 
 def replica_step_impl(
-    cfg: MinPaxosConfig, state: ReplicaState, inbox: MsgBatch
+    cfg: MinPaxosConfig, state: ReplicaState, inbox: MsgBatch,
+    tick_inc=1,
 ) -> tuple[ReplicaState, Outbox, ExecResult]:
     """Advance one replica by one batch of messages (pure, unjitted —
     models/cluster.py vmaps this over the replica axis).
 
     Handles every message kind in one fused, branch-free pass; see
     module docstring for the reference-call mapping.
+
+    ``tick_inc``: wall-clock ticks this step represents. The TCP
+    runtime's fused burst path (runtime/replica.py) runs k protocol
+    substeps inside ONE host tick; crediting each substep a full tick
+    would make the stall/retry counters reach their thresholds k times
+    faster than wall time — exactly the duplicate-accept churn the
+    round-5 threshold tuning removed. The fused path passes 1 for the
+    first substep and 0 for the rest; every other caller uses the
+    default 1.
     """
     S, R = cfg.window, cfg.n_replicas
     M = inbox.kind.shape[0]  # actual batch rows (pending + ext concat)
@@ -777,10 +787,10 @@ def replica_step_impl(
     advanced = state.committed_upto > old_upto
     in_flight = state.crt_inst - 1 > state.committed_upto
     state = state._replace(
-        tick=state.tick + 1,
+        tick=state.tick + tick_inc,
         stall_ticks=jnp.where(
             state.is_leader & state.prepared & in_flight & ~advanced,
-            state.stall_ticks + 1, 0))
+            state.stall_ticks + tick_inc, 0))
     # classic mode broadcasts the frontier EVERY step (one row): with
     # the Accept piggyback inert, an idle leader's followers would
     # otherwise never learn the last commits (the reference instead

@@ -8,6 +8,10 @@ from minpaxos_tpu.ops.kvstore import (
     kv_apply_batch,
     kv_apply_batch_lanes,
 )
+# NOTE: ops.substeps is deliberately NOT re-exported here: it imports
+# from models.minpaxos (MsgBatch, status codes), and models imports
+# ops submodules — routing substeps through this package __init__
+# closes that loop. Import it directly, like ops.winner / ops.ackruns.
 
 __all__ = [
     "segmented_scan_max",

@@ -277,7 +277,10 @@ def test_load_prior_tpu_record_hermetic(tmp_path):
 def test_failed_ladder_attaches_prior_tpu_record(monkeypatch):
     """When every rung fails, the failure record carries the saved
     prior TPU measurement as labeled context; the live headline stays
-    honestly 0.0."""
+    honestly 0.0, with the unmissable top-level markers: a
+    measured_this_run=false flag and the replay file's mtime sitting
+    NEXT TO the value fields (VERDICT round-5 item 8 — a BENCH_rN
+    produced on a dead relay must not be misread as fresh)."""
     import json
     import types
 
@@ -292,7 +295,9 @@ def test_failed_ladder_attaches_prior_tpu_record(monkeypatch):
     monkeypatch.setattr(bench, "_wait_for_backend", lambda **kw: "tpu")
     monkeypatch.setattr(
         bench, "load_prior_tpu_record",
-        lambda repo_dir=None: {"file": "x.json", "record": {"value": 9.0}})
+        lambda repo_dir=None: {"file": "x.json",
+                               "file_mtime_utc": "2026-07-31T04:36:00Z",
+                               "record": {"value": 9.0}})
     out = []
     monkeypatch.setattr("builtins.print", lambda *a, **kw: out.append(a))
     monkeypatch.setenv("JAX_PLATFORMS", "tpu")
@@ -301,3 +306,52 @@ def test_failed_ladder_attaches_prior_tpu_record(monkeypatch):
     final = json.loads(out[-1][0])
     assert final["value"] == 0.0 and final["error"]
     assert final["prior_tpu_record"]["record"]["value"] == 9.0
+    # top-level self-description: not measured, and the replay's age
+    # is right next to the (zero) value
+    assert final["measured_this_run"] is False
+    assert final["replayed_value"] == 9.0
+    assert final["replayed_record_mtime_utc"] == "2026-07-31T04:36:00Z"
+
+
+def test_failure_record_marks_not_measured_without_replay(monkeypatch):
+    """A failure record with NO prior artifact still carries
+    measured_this_run=false and no replay fields."""
+    import json
+
+    import bench
+
+    out = []
+    monkeypatch.setattr("builtins.print", lambda *a, **kw: out.append(a))
+    bench._failure("probe", "backend unreachable")
+    rec = json.loads(out[-1][0])
+    assert rec["value"] == 0.0
+    assert rec["measured_this_run"] is False
+    assert "replayed_record_mtime_utc" not in rec
+
+
+def test_fresh_ladder_record_marks_measured(monkeypatch):
+    """A successful rung's record says measured_this_run=true — the
+    positive half of the self-description contract."""
+    import json
+    import types
+
+    import bench
+
+    rec = {"value": 100.0, "platform": "tpu", "measured_this_run": True,
+           "kill_recover": {"victim": 2}}
+
+    def fake_run(cmd, env=None, stdout=None, timeout=None):
+        return types.SimpleNamespace(
+            returncode=0, stdout=(json.dumps(rec) + "\n").encode())
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench, "_wait_for_backend",
+                        lambda **kw: "tpu" if not out else None)
+    out = []
+    monkeypatch.setattr("builtins.print", lambda *a, **kw: out.append(a))
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.delenv("MP_BENCH_CHILD", raising=False)
+    bench.main()
+    final = json.loads(out[-1][0])
+    assert final["value"] == 100.0
+    assert final["measured_this_run"] is True

@@ -1,0 +1,375 @@
+"""Fused multi-tick dispatches, the idle fast path, and the narrow
+resident view (runtime/replica.py + ops/substeps.py).
+
+The fused path's claim is exactness, not approximation: k substeps
+inside one ``lax.scan`` dispatch must produce the same commits,
+replies and outbox rows as k sequential dispatches fed the same
+trace — with the one DELIBERATE difference that wall-tick counters
+(tick / stall_ticks) advance once per dispatch, not once per substep
+(tick_inc). These tests pin both halves of that contract, for both
+protocol kernels, against a realistic recorded exchange (propose ->
+accept -> ack -> commit), plus the narrow view's
+full-state-equivalence and the idle fast path's no-dispatch guarantee
+on a live server.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.mencius import init_mencius, mencius_step_impl
+from minpaxos_tpu.models.minpaxos import (
+    MinPaxosConfig,
+    MsgBatch,
+    become_leader,
+    init_replica,
+    replica_step_impl,
+)
+from minpaxos_tpu.ops.substeps import (
+    SCAL_EXEC_COUNT,
+    SCAL_FRONTIER,
+    SCAL_WINDOW_BASE,
+    pack_outputs,
+)
+from minpaxos_tpu.runtime.replica import _packed_step
+from minpaxos_tpu.wire.messages import MsgKind, Op
+
+CFG = MinPaxosConfig(n_replicas=3, window=128, inbox=32, exec_batch=16,
+                     kv_pow2=8, catchup_rows=8, recovery_rows=8,
+                     gossip_ticks=1)
+
+
+def _mk(cols) -> MsgBatch:
+    return MsgBatch(**{c: jnp.asarray(cols[c]) for c in MsgBatch._fields})
+
+
+def _empty_cols(m: int):
+    return {c: np.zeros(m, np.int32) for c in MsgBatch._fields}
+
+
+def _copy(st):
+    return jax.tree_util.tree_map(lambda x: x.copy(), st)
+
+
+def _propose_cols(cfg, n: int, base_cmd: int = 0):
+    cols = _empty_cols(cfg.inbox)
+    cols["kind"][:n] = int(MsgKind.PROPOSE)
+    cols["src"][:n] = -1
+    cols["op"][:n] = int(Op.PUT)
+    cols["key_lo"][:n] = 100 + np.arange(n)
+    cols["val_lo"][:n] = 500 + np.arange(n)
+    cols["cmd_id"][:n] = base_cmd + np.arange(n)
+    cols["client_id"][:n] = 7
+    return cols
+
+
+def _rows_of_kind(outbox, kind: MsgKind, m: int):
+    """Extract one kind's live rows from a kernel outbox into inbox
+    columns — the array analogue of the wire round trip."""
+    msgs, k = outbox.msgs, int(kind)
+    mask = np.asarray(msgs.kind) == k
+    cols = _empty_cols(m)
+    n = int(mask.sum())
+    assert n <= m
+    for c in MsgBatch._fields:
+        cols[c][:n] = np.asarray(getattr(msgs, c))[mask]
+    return cols, n
+
+
+def _prepared_leader(cfg, init_fn=init_replica, step=replica_step_impl):
+    st = init_fn(cfg, 0)
+    st, prep = become_leader(cfg, st)
+    cols = _empty_cols(cfg.inbox)
+    for i, src in enumerate(range(1, cfg.n_replicas)):
+        cols["kind"][i] = int(MsgKind.PREPARE_REPLY)
+        cols["src"][i] = src
+        cols["ballot"][i] = int(prep.ballot[0])
+        cols["op"][i] = 1  # ok
+        cols["last_committed"][i] = -1
+    st, _, _ = step(cfg, st, _mk(cols))
+    assert bool(st.prepared)
+    return _copy(st)
+
+
+def _leader_trace(cfg):
+    """A recorded minpaxos exchange: the leader's inboxes for (1) a
+    propose batch, (2) the follower acks those accepts generated."""
+    lead = _prepared_leader(cfg)
+    fol = _copy(init_replica(cfg, 1))
+    b_prop = _propose_cols(cfg, 4)
+    lead2, out, _ = replica_step_impl(cfg, _copy(lead), _mk(b_prop))
+    acc, n_acc = _rows_of_kind(out, MsgKind.ACCEPT, cfg.inbox)
+    assert n_acc >= 4
+    _, fol_out, _ = replica_step_impl(cfg, fol, _mk(acc))
+    acks, n_ack = _rows_of_kind(fol_out, MsgKind.ACCEPT_REPLY, cfg.inbox)
+    assert n_ack >= 1
+    return lead, [b_prop, acks]
+
+
+def _mencius_trace(cfg):
+    """Same shape of exchange for the mencius kernel (owner 0 drives
+    its slots; replica 1 acks)."""
+    own = _copy(init_mencius(cfg, 0))
+    fol = _copy(init_mencius(cfg, 1))
+    b_prop = _propose_cols(cfg, 4)
+    _, out, _ = mencius_step_impl(cfg, _copy(own), _mk(b_prop))
+    acc, n_acc = _rows_of_kind(out, MsgKind.ACCEPT, cfg.inbox)
+    assert n_acc >= 4
+    _, fol_out, _ = mencius_step_impl(cfg, fol, _mk(acc))
+    acks, n_ack = _rows_of_kind(fol_out, MsgKind.ACCEPT_REPLY, cfg.inbox)
+    assert n_ack >= 1
+    return own, [b_prop, acks]
+
+
+def _seq_substeps(cfg, st, inbox, step_impl, k):
+    """Reference semantics: k sequential steps, real inbox first, the
+    rest empty, tick credited once — exactly what the fused scan
+    claims to compute."""
+    outs = []
+    empty = jax.tree_util.tree_map(jnp.zeros_like, inbox)
+    for i in range(k):
+        st, ob, ex = step_impl(cfg, st, inbox if i == 0 else empty,
+                               1 if i == 0 else 0)
+        outs.append(pack_outputs(st, ob, ex))
+    return st, outs
+
+
+def _assert_trees_equal(a, b, context: str):
+    for pa, pb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        assert np.array_equal(np.asarray(pa), np.asarray(pb)), context
+
+
+@pytest.mark.parametrize("proto,trace_fn,step_impl,min_frontier", [
+    ("minpaxos", _leader_trace, replica_step_impl, 3),
+    # mencius: only owner 0's slots (0, 3, 6, 9) commit here; the
+    # GLOBAL blocking frontier stops at slot 0 until the other owners
+    # cede their interleaved slots, which this two-party trace never
+    # triggers — slot-status commitment is asserted instead
+    ("mencius", _mencius_trace, mencius_step_impl, 0),
+])
+def test_fused_equals_sequential(proto, trace_fn, step_impl, min_frontier):
+    """k fused substeps == k sequential substeps, EXACTLY (state and
+    every packed output), along a recorded propose/ack trace."""
+    st, trace = trace_fn(CFG)
+    st_f, st_s = _copy(st), _copy(st)
+    for batch in trace:
+        inbox = _mk(batch)
+        st_f, om, em, sc = _packed_step(CFG, st_f, inbox, step_impl, 3)
+        st_s, outs = _seq_substeps(CFG, st_s, inbox, step_impl, 3)
+        for i, (o, e, s) in enumerate(outs):
+            assert np.array_equal(np.asarray(om)[i], np.asarray(o)), (
+                proto, i)
+            assert np.array_equal(np.asarray(em)[i], np.asarray(e)), (
+                proto, i)
+            assert np.array_equal(np.asarray(sc)[i], np.asarray(s)), (
+                proto, i)
+        _assert_trees_equal(st_f, st_s, proto)
+    # the trace ends with the driver holding commits: the fused run
+    # must have actually committed and executed (not just matched a
+    # do-nothing reference)
+    assert int(st_f.committed_upto) >= min_frontier
+    assert int(st_f.executed_upto) >= min_frontier
+    assert int((np.asarray(st_f.status) >= 4).sum()) >= 4  # COMMITTED+
+
+
+@pytest.mark.parametrize("proto,trace_fn,step_impl,min_execs", [
+    ("minpaxos", _leader_trace, replica_step_impl, 4),
+    ("mencius", _mencius_trace, mencius_step_impl, 1),
+])
+def test_fused_commits_match_unfused_ticks(proto, trace_fn, step_impl,
+                                           min_execs):
+    """The wall-honest form of equivalence: the same trace driven as
+    plain k=1 dispatches (each a full wall tick) reaches the same
+    commits and produces the same executed commands — tick counters
+    are the ONLY intended difference."""
+    st, trace = trace_fn(CFG)
+    t0 = int(st.tick)
+    st_f, st_u = _copy(st), _copy(st)
+    exec_f, exec_u = [], []
+
+    def run(st, fused: bool, sink):
+        for batch in trace:
+            k = 3 if fused else 1
+            st, om, em, sc = _packed_step(CFG, st, _mk(batch),
+                                          step_impl, k)
+            sc = np.asarray(sc)
+            for i in range(k):
+                n = int(sc[i][SCAL_EXEC_COUNT])
+                sink.extend(np.asarray(em)[i][4][:n].tolist())  # cmd_id
+            if not fused:  # give the unfused run its follow-up ticks
+                for _ in range(2):
+                    st, om, em, sc2 = _packed_step(
+                        CFG, st, _mk(_empty_cols(CFG.inbox)), step_impl, 1)
+                    n = int(np.asarray(sc2)[0][SCAL_EXEC_COUNT])
+                    sink.extend(np.asarray(em)[0][4][:n].tolist())
+        return st
+
+    st_f = run(st_f, True, exec_f)
+    st_u = run(st_u, False, exec_u)
+    assert int(st_f.committed_upto) == int(st_u.committed_upto)
+    assert exec_f == exec_u and len(exec_f) >= min_execs
+    # counters: fused credited 1 tick per dispatch, unfused 3
+    assert int(st_f.tick) - t0 == len(trace)
+    assert int(st_u.tick) - t0 == 3 * len(trace)
+
+
+def test_tick_inc_zero_freezes_stall_counter():
+    """A trailing fused substep (tick_inc=0) must not age the stall
+    counter — the retry/no-op-fill thresholds are wall-time contracts
+    (PERF.md round-5: a threshold reached early rebroadcasts accepts
+    that are merely in flight)."""
+    lead = _prepared_leader(CFG)
+    # one in-flight proposal, never acked -> stalling
+    st, _, _ = replica_step_impl(CFG, _copy(lead), _mk(_propose_cols(CFG, 1)))
+    empty = _mk(_empty_cols(CFG.inbox))
+    s0 = int(st.stall_ticks)
+    st, _, _ = replica_step_impl(CFG, st, empty, 0)
+    st, _, _ = replica_step_impl(CFG, st, empty, 0)
+    assert int(st.stall_ticks) == s0
+    st, _, _ = replica_step_impl(CFG, st, empty, 1)
+    assert int(st.stall_ticks) == s0 + 1
+
+
+def _committed_leader(cfg):
+    """A leader with a few committed+executed slots and peers reported
+    up to date — the state shape the narrow view targets."""
+    lead = _prepared_leader(cfg)
+    lead, out, _ = replica_step_impl(cfg, lead, _mk(_propose_cols(cfg, 4)))
+    acc, _ = _rows_of_kind(out, MsgKind.ACCEPT, cfg.inbox)
+    fol = _copy(init_replica(cfg, 1))
+    _, fol_out, _ = replica_step_impl(cfg, fol, _mk(acc))
+    acks, _ = _rows_of_kind(fol_out, MsgKind.ACCEPT_REPLY, cfg.inbox)
+    lead, _, _ = replica_step_impl(cfg, lead, _mk(acks))
+    assert int(lead.committed_upto) >= 3
+    fr = int(lead.committed_upto)
+    return _copy(lead._replace(
+        peer_commits=jnp.full(cfg.n_replicas, fr, jnp.int32)))
+
+
+def test_narrow_view_matches_full_step():
+    """The small-window specialized step is exact when the live span
+    fits the view: full-window step vs narrow view at both a zero and
+    a mid-window offset, state and outputs compared leaf-for-leaf."""
+    cfg = CFG._replace(window=256)
+    lead = _committed_leader(cfg)
+    exec_edge = int(lead.executed_upto) + 1
+    assert exec_edge >= 4
+    follow_up = _propose_cols(cfg, 3, base_cmd=50)
+    for off in (0, exec_edge):
+        full_st, fo, fe, fs = _packed_step(
+            cfg, _copy(lead), _mk(follow_up), replica_step_impl, 1, 0, 0)
+        nar_st, no, ne, ns = _packed_step(
+            cfg, _copy(lead), _mk(follow_up), replica_step_impl, 1, 64,
+            jnp.int32(off))
+        _assert_trees_equal(full_st, nar_st, f"state off={off}")
+        assert np.array_equal(np.asarray(fo), np.asarray(no)), off
+        assert np.array_equal(np.asarray(fe), np.asarray(ne)), off
+        assert np.array_equal(np.asarray(fs), np.asarray(ns)), off
+        assert int(np.asarray(ns)[0][SCAL_WINDOW_BASE]) == 0
+        # the step did real work: new proposals accepted
+        assert int(nar_st.crt_inst) == int(lead.crt_inst) + 3
+
+
+def test_narrow_view_fused_commits():
+    """narrow x fused compose: a k=2 burst inside a 64-slot view
+    commits + executes the backlog exactly like the full-window run."""
+    cfg = CFG._replace(window=256, exec_batch=2)
+    lead = _committed_leader(cfg)
+    # exec_batch=2 but 4+ commits: the backlog needs multiple substeps
+    lead = lead._replace(executed_upto=jnp.int32(-1),
+                         status=jnp.where(lead.status > 0,
+                                          jnp.uint8(4), lead.status))
+    empty = _mk(_empty_cols(cfg.inbox))
+    full_st, _, _, fs = _packed_step(
+        cfg, _copy(lead), empty, replica_step_impl, 2, 0, 0)
+    nar_st, _, _, ns = _packed_step(
+        cfg, _copy(lead), empty, replica_step_impl, 2, 64, jnp.int32(0))
+    _assert_trees_equal(full_st, nar_st, "fused narrow")
+    assert int(np.asarray(ns)[-1][SCAL_FRONTIER]) == int(
+        full_st.committed_upto)
+    assert int(full_st.executed_upto) >= 3  # two substeps x batch 2
+
+
+def test_idle_fastpath_skips_device_dispatch():
+    """A quiet prepared replica must answer idle polls WITHOUT device
+    dispatches (stats['dispatches'] frozen, stats['idle_skips']
+    counting) until a message arrives — the round-6 idle fast path."""
+    from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
+    from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
+    from minpaxos_tpu.wire.messages import make_batch
+
+    port = free_ports(1, sibling_offset=CONTROL_OFFSET)[0]
+    cfg = MinPaxosConfig(n_replicas=1, window=64, inbox=16, exec_batch=8,
+                         kv_pow2=6, catchup_rows=4, recovery_rows=4)
+    flags = RuntimeFlags(idle_skip_max_s=30.0, idle_s=0.01,
+                         store_dir="/tmp")
+    srv = ReplicaServer(0, [("127.0.0.1", port)], cfg, flags)
+    srv.start()
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (srv.snapshot["prepared"]
+                    and not srv.snapshot.get("work_pending", True)):
+                break
+            time.sleep(0.05)
+        assert srv.snapshot["prepared"], srv.snapshot
+        assert not srv.snapshot["work_pending"], srv.snapshot
+        before = dict(srv.stats)
+        time.sleep(0.5)  # ~50 idle polls at idle_s=0.01
+        after = dict(srv.stats)
+        assert after["dispatches"] == before["dispatches"], (before, after)
+        assert after["idle_skips"] > before["idle_skips"] + 5
+        # a message still forces a dispatch immediately
+        rows = make_batch(MsgKind.PROPOSE, cmd_id=np.asarray([1]),
+                          op=int(Op.PUT), key=np.asarray([11]),
+                          val=np.asarray([22]), timestamp=0)
+        from minpaxos_tpu.runtime.transport import FROM_CLIENT
+        srv.queue.put((FROM_CLIENT, 999, MsgKind.PROPOSE, rows))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if srv.stats["dispatches"] > after["dispatches"]:
+                break
+            time.sleep(0.05)
+        assert srv.stats["dispatches"] > after["dispatches"]
+        # and the command committed (single-replica majority = 1)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if srv.snapshot["frontier"] >= 0:
+                break
+            time.sleep(0.05)
+        assert srv.snapshot["frontier"] >= 0
+    finally:
+        srv.stop()
+
+
+def test_kv_sizing_startup_line_and_saturation_warning(tmp_path, capsys):
+    """-kvpow2 footgun mitigation: the startup line states capacity vs
+    the workload hint, and the periodic load check warns before the
+    fail-stop can trigger."""
+    from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
+    from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
+
+    port = free_ports(1, sibling_offset=CONTROL_OFFSET)[0]
+    cfg = MinPaxosConfig(n_replicas=1, window=64, inbox=16, exec_batch=8,
+                         kv_pow2=6, catchup_rows=4, recovery_rows=4)
+    flags = RuntimeFlags(store_dir=str(tmp_path), key_hint=60)
+    srv = ReplicaServer(0, [("127.0.0.1", port)], cfg, flags)
+    srv._log_kv_sizing()
+    err = capsys.readouterr().err
+    assert "KV table capacity 64" in err
+    assert "projected load 0.94" in err and "OVER" in err
+    # saturation warning: force a near-full table + a check-due tick
+    srv.stats["dispatches"] = 1024
+    srv.state = srv.state._replace(
+        kv=srv.state.kv._replace(slot=jnp.ones_like(srv.state.kv.slot)))
+    srv._check_kv_load()
+    err = capsys.readouterr().err
+    assert "NEAR SATURATION" in err
+    assert srv._kv_warned
+    srv.store.close()

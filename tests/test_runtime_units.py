@@ -182,9 +182,13 @@ def test_packed_step_layout_matches_cols():
     row["val_lo"][0] = 9
     row["cmd_id"][0] = 3
     inbox = MsgBatch(**{k: jnp.asarray(v) for k, v in row.items()})
-    st2, out_mat, exec_mat, scal = _packed_step(
+    st2, out_mats, exec_mats, scals = _packed_step(
         cfg, st, inbox, replica_step_impl)
-    out_mat = np.asarray(out_mat)
+    # outputs are stacked per substep (k=1 here): [1, 14, M] / [1, 6,
+    # E] / [1, N_SCAL]
+    assert out_mats.shape[0] == exec_mats.shape[0] == scals.shape[0] == 1
+    out_mat = np.asarray(out_mats)[0]
+    scal = scals[0]
     ncols = len(batches.COLS)
     assert out_mat.shape[0] == ncols + 2
     cols = {c: out_mat[i] for i, c in enumerate(batches.COLS)}
@@ -199,12 +203,18 @@ def test_packed_step_layout_matches_cols():
     assert cols["cmd_id"][i] == 3
     dst = out_mat[ncols]
     assert dst[i] == -2  # client-bound
-    # scal layout: frontier, window_base, crt_inst, dropped, lo, count,
-    # leader, prepared
+    # scal layout: ops/substeps.py SCAL_* (frontier, window_base,
+    # crt_inst, dropped, lo, count, leader, prepared, executed, low
+    # anchor, high anchor, work_pending)
+    from minpaxos_tpu.ops import substeps
+
     scal = np.asarray(scal)
-    assert scal.shape == (8,)
+    assert scal.shape == (substeps.N_SCAL,)
     assert scal[0] == -1 and scal[1] == 0  # nothing committed yet
     assert scal[6] == 0 and scal[7] == 0  # leader 0, not yet prepared
+    assert scal[substeps.SCAL_EXECUTED] == -1
+    # an unprepared leader has pending work (the prepare round)
+    assert scal[substeps.SCAL_WORK_PENDING] == 1
 
 
 def test_cluster_step_strips_exec_gate():

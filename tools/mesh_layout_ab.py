@@ -1,0 +1,117 @@
+"""Replica-axis mesh layout A/B: shard-only vs replicas-over-chips.
+
+VERDICT round-5 weak #6: the ``n_replica_devices > 1`` layout
+(parallel/mesh.py) — each consensus group's replicas spread across
+chips, turning the routing gather into inter-chip collectives — is
+executed by a smoke test but has never been MEASURED against the
+default all-shards layout. This tool runs the same fused workload at
+one fixed shape under both layouts on the visible device mesh (the
+8-virtual-device CPU mesh in CI; a real chip mesh when present) and
+prints a comparison table for PERF.md.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+         python tools/mesh_layout_ab.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+from minpaxos_tpu.parallel.mesh import make_mesh
+from minpaxos_tpu.parallel.sharded import (
+    elect_all,
+    init_sharded,
+    make_propose_ext,
+    sharded_run,
+)
+
+
+def run_layout(n_replica_devices: int, g: int, w: int, p: int, k: int,
+               dispatches: int) -> dict:
+    """One layout's measurement: boot, elect, warm, time fused rounds."""
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_shard_devices=n_dev // n_replica_devices,
+                     n_replica_devices=n_replica_devices)
+    cfg = MinPaxosConfig(n_replicas=4, window=w, inbox=p + 2 * 64 + 64,
+                         exec_batch=p, kv_pow2=10, catchup_rows=64,
+                         recovery_rows=64)
+    ss = init_sharded(cfg, g)
+
+    def put(x):
+        spec = (P("shard", "replica") if x.ndim >= 2
+                else P("shard") if x.ndim >= 1 else P())
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    ss = jax.tree_util.tree_map(put, ss)
+    ss = elect_all(cfg, ss, 0)
+    ext_sharding = NamedSharding(mesh, P("shard"))
+
+    def fused(ss, seed):
+        ss, uptos, crts = sharded_run(
+            cfg, g, p, k, ss, jnp.int32(p), jnp.int32(0), jnp.int32(seed))
+        return ss, uptos
+
+    # two quiet steps deliver prepares/replies; then warm the fused path
+    ss, _ = fused(ss, 0)
+    start = int((np.asarray(ss.states.committed_upto[:, 0]) + 1).sum())
+    t0 = time.perf_counter()
+    for d in range(dispatches):
+        ss, uptos = fused(ss, 1 + d)
+        np.asarray(uptos)  # block
+    wall = time.perf_counter() - t0
+    committed = int((np.asarray(
+        ss.states.committed_upto[:, 0]) + 1).sum()) - start
+    return {
+        "layout": (f"shard-only ({n_dev}x1)" if n_replica_devices == 1
+                   else f"replica-axis ({n_dev // n_replica_devices}"
+                        f"x{n_replica_devices})"),
+        "inst_per_sec": round(committed / wall, 1),
+        "ms_per_round": round(wall / (dispatches * k) * 1e3, 3),
+        "committed": committed,
+        "wall_s": round(wall, 2),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--window", type=int, default=1024)
+    ap.add_argument("--props", type=int, default=128)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--dispatches", type=int, default=3)
+    args = ap.parse_args()
+    print(f"backend: {jax.devices()[0].platform}, "
+          f"{len(jax.devices())} devices", file=sys.stderr)
+    rows = []
+    for nrd in (1, 2):
+        rec = run_layout(nrd, args.shards, args.window, args.props,
+                         args.k, args.dispatches)
+        rows.append(rec)
+        print(rec, flush=True)
+    a, b = rows
+    ratio = (b["ms_per_round"] / a["ms_per_round"]
+             if a["ms_per_round"] else float("nan"))
+    print(f"replica-axis / shard-only round cost: {ratio:.2f}x "
+          f"(fixed shape g={args.shards} w={args.window} "
+          f"p={args.props} R=4, k={args.k})")
+
+
+if __name__ == "__main__":
+    main()

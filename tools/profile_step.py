@@ -96,12 +96,79 @@ def bench_kv(cfg_label: str, cap_pow2: int, b: int, iters=20) -> None:
           f" {ms:8.2f} ms/call")
 
 
+def decompose(window: int = 512, iters: int = 40) -> None:
+    """Split the per-tick cost into dispatch floor vs marginal compute
+    at the serial-latency shape (bench_tcp.py SERIAL_SHAPE) — the
+    round-6 question behind VERDICT item 5: how much of the 0.3-0.9 ms
+    tick is the host->device round trip that fused substeps amortize?
+
+    Method: time the packed k-substep step for k=1..4; the slope
+    (t_k - t_1)/(k-1) is one substep's pure compute (substeps share
+    one dispatch), so t_1 minus the slope is the dispatch floor. Also
+    A/Bs the narrow resident view: a server-default 16384-slot window
+    stepped full-width vs through a 512-slot view.
+    """
+    from minpaxos_tpu.models.minpaxos import replica_step_impl
+    from minpaxos_tpu.runtime.replica import _packed_step
+
+    cfg = MinPaxosConfig(n_replicas=3, window=window, inbox=256,
+                         exec_batch=64, kv_pow2=12, catchup_rows=256,
+                         recovery_rows=256, gossip_ticks=4)
+    prop = propose_inbox(cfg, 1, to_leader=True)  # a serial op's tick
+
+    def timed(k: int) -> float:
+        holder = [jax.tree.map(jnp.copy, init_replica(cfg, 0))]
+
+        def once():
+            st, om, em, sc = _packed_step(cfg, holder[0], prop,
+                                          replica_step_impl, k)
+            jax.block_until_ready(sc)
+            holder[0] = st
+
+        return _time(once, iters)
+
+    ts = {k: timed(k) for k in (1, 2, 3, 4)}
+    slope = (ts[4] - ts[1]) / 3
+    floor = max(ts[1] - slope, 0.0)
+    print(f"\n-- dispatch-vs-compute decomposition, W={window} "
+          f"(1-prop tick, serial shape) --")
+    for k, t in ts.items():
+        print(f"  k={k} substeps/dispatch {t:8.3f} ms "
+              f"({t / k:.3f} ms/substep amortized)")
+    print(f"  marginal substep compute {slope:8.3f} ms")
+    print(f"  dispatch floor (t1 - marginal) {floor:8.3f} ms "
+          f"({100 * floor / ts[1]:.0f}% of a k=1 tick)")
+
+    # narrow view A/B: server-default window, low occupancy
+    big = MinPaxosConfig(n_replicas=3, window=1 << 14, inbox=256,
+                         exec_batch=64, kv_pow2=12, catchup_rows=256,
+                         recovery_rows=256, gossip_ticks=4)
+    bprop = propose_inbox(big, 1, to_leader=True)
+    for narrow in (0, 512):
+        holder = [jax.tree.map(jnp.copy, init_replica(big, 0))]
+
+        def once():
+            st, om, em, sc = _packed_step(big, holder[0], bprop,
+                                          replica_step_impl, 1, narrow,
+                                          jnp.int32(0))
+            jax.block_until_ready(sc)
+            holder[0] = st
+
+        label = f"narrow view W=16384->{narrow}" if narrow else \
+            "full step  W=16384"
+        print(f"  {label:28s} {_time(once, iters):8.3f} ms/tick")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--window", type=int, default=4096)
     ap.add_argument("--inbox", type=int, default=2048)
     ap.add_argument("--props", type=int, default=512)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--no-decompose", action="store_true",
+                    help="skip the dispatch-vs-compute / narrow-view "
+                         "section (it compiles extra W=16384 and fused "
+                         "variants — minutes on slow hosts)")
     args = ap.parse_args()
 
     print(f"backend: {jax.devices()[0].platform}", file=sys.stderr)
@@ -126,6 +193,9 @@ def main() -> None:
     for cap in (16, 20):
         for b in (512, 2048):
             bench_kv("", cap, b, args.iters)
+
+    if not args.no_decompose:
+        decompose(iters=args.iters)
 
 
 if __name__ == "__main__":

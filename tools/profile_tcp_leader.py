@@ -48,8 +48,17 @@ def main() -> None:
                          catchup_rows=256, recovery_rows=256)
     prof = cProfile.Profile()
     servers = []
+    # A/B knobs for the fused/idle/narrow paths (round 6): e.g.
+    #   PROF_FUSE=1 PROF_IDLEFAST=0 python tools/profile_tcp_leader.py
+    # reproduces the pre-round-6 runtime; the stats block printed at
+    # the end carries the dispatch/fused/idle-skip counts either way.
+    fuse = int(os.environ.get("PROF_FUSE", "3"))
+    idlefast = os.environ.get("PROF_IDLEFAST", "1") != "0"
+    narrow = int(os.environ.get("PROF_NARROW", "0"))
     for i, p in enumerate(dports):
         flags = RuntimeFlags(durable=True, store_dir=tmp,
+                             fuse_ticks=fuse, idle_fastpath=idlefast,
+                             narrow_window=narrow,
                              profile=prof if i == 0 else None)
         s = ReplicaServer(i, [("127.0.0.1", pp) for pp in dports],
                           cfg, flags)
@@ -71,6 +80,15 @@ def main() -> None:
     print(f"acked {stats['acked']}/{q} in {wall:.2f}s "
           f"({stats['acked']/wall:.0f} ops/s)", file=sys.stderr)
     cli.close_conn()
+    print(f"knobs: fuse_ticks={fuse} idle_fastpath={idlefast} "
+          f"narrow={narrow}", file=sys.stderr)
+    for i, s in enumerate(servers):
+        d = s.stats
+        print(f"replica {i}: dispatches={d['dispatches']} "
+              f"fused_substeps={d['fused_substeps']} "
+              f"idle_skips={d['idle_skips']} "
+              f"narrow_steps={d['narrow_steps']} ticks={d['ticks']}",
+              file=sys.stderr)
     for s in servers:
         s.stop()
     master.stop()

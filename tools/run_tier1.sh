@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# The one blessed test entry point.
+#
+#   tools/run_tier1.sh          — the ROADMAP.md tier-1 line, verbatim
+#                                 (full 'not slow' suite + DOTS_PASSED
+#                                 count; ~10-13 min on the 1-core host)
+#   tools/run_tier1.sh smoke    — fast pre-commit smoke: runtime + wire
+#                                 units only (~2 min)
+#
+# Builders and CI invoke this instead of re-deriving the pytest flags:
+# the tier-1 command's exact flags (marker filter, plugin disables,
+# collection-error tolerance) ARE the acceptance contract, and ad-hoc
+# variations have produced incomparable pass counts before.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "smoke" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        -k "runtime_units or wire or fused" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
+# ROADMAP.md tier-1 verify line, verbatim:
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
