@@ -1,0 +1,48 @@
+"""paxlint — consensus-aware static analysis for this repo.
+
+The repo has three classes of hazard that ordinary linters cannot see
+and tier-1 tests only catch by luck:
+
+* **JAX hot-path hazards** — a host sync (``.item()``, ``int()`` on a
+  traced value, ``np.asarray`` of a device array) or a Python branch
+  on a traced value inside a jit-reachable kernel stalls every
+  protocol tick behind a device round-trip, or silently retraces.
+* **Wire-contract drift** — opcodes and row widths in
+  ``wire/messages.py`` are a cross-version, cross-language contract
+  (SURVEY.md flags the reference's registration-order codes as
+  fragile); a renumbered opcode or a resized field corrupts frames
+  between builds that were never supposed to disagree.
+* **Threaded-runtime races** — the TCP runtime is single-owner by
+  convention (transport.py docstring); a shared-attribute write from a
+  reader thread without the owning ``_lock``, or a blocking socket
+  call made while holding it, breaks that convention silently.
+
+``tools/lint.py`` runs every registered pass over the tree and exits
+nonzero on violations; ``tools/run_tier1.sh`` runs it before pytest so
+the contract is enforced on every PR. Suppress a deliberate violation
+with a same-line comment::
+
+    x = np.asarray(hi)  # paxlint: disable=trace-hazard -- host helper
+
+See ANALYSIS.md at the repo root for the rule catalogue.
+"""
+
+from minpaxos_tpu.analysis.core import (
+    PASSES,
+    Project,
+    Violation,
+    register,
+    run_passes,
+)
+
+# importing the pass modules registers them
+from minpaxos_tpu.analysis import (  # noqa: E402,F401  (registration)
+    broad_except,
+    concurrency,
+    recompile_hazard,
+    trace_hazard,
+    wall_honesty,
+    wire_contract,
+)
+
+__all__ = ["PASSES", "Project", "Violation", "register", "run_passes"]

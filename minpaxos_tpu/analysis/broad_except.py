@@ -1,0 +1,64 @@
+"""broad-except: bare ``except Exception`` swallows consensus bugs.
+
+Production failure mode: the runtime's error philosophy is *fail-stop
+or heal explicitly* (FatalReplicaError in runtime/replica.py — serving
+wrong data is the one thing consensus cannot tolerate). A handler that
+catches ``Exception`` (or everything) converts a correctness bug — a
+codec error, a store corruption, a protocol invariant violation — into
+silence, which presents as the wedges the round-5 hunts spent days on.
+Catch the exceptions a call site actually raises (``OSError``,
+``json.JSONDecodeError``, ...), and log what was swallowed.
+
+A handler that re-raises is exempt: wrap-and-rethrow is narrowing,
+not swallowing. Deliberately-broad best-effort paths (optional native
+builds, cache setup) carry a ``# paxlint: disable=broad-except`` with
+their reason, so the decision is visible at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "broad-except"
+
+SCOPE_PREFIX = "minpaxos_tpu/"
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare `except:`
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files.values():
+        if f.tree is None or not f.path.startswith(SCOPE_PREFIX):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and not _reraises(node):
+                what = ("bare `except:`" if node.type is None
+                        else "`except Exception`")
+                out.append(Violation(
+                    f.path, node.lineno, RULE,
+                    f"{what} swallows correctness bugs as silence — "
+                    "catch the exceptions this call site actually "
+                    "raises, or suppress with the reason"))
+    return out

@@ -1,0 +1,243 @@
+"""concurrency: lock discipline in the threaded TCP runtime.
+
+The runtime is single-owner by convention (transport.py docstring):
+reader threads decode and enqueue, the protocol thread owns state and
+writers, and the few genuinely shared structures (peer/client maps,
+master membership) are guarded by an ``_lock``. The reference's Go
+code ships *benign* data races (SURVEY.md section 5) because tooling
+never looked; this pass makes the convention checkable:
+
+* **unlocked-write** — inside any method reachable from a
+  ``threading.Thread(target=...)`` entry, a write (assignment,
+  augmented assignment, subscript store, or mutating method call) to a
+  ``self.`` attribute that the same class accesses under its ``_lock``
+  elsewhere, without holding that lock. Failure mode: a half-updated
+  peer map read mid-rehash, a lost liveness update — races that
+  present as one-in-a-thousand-runs wedges.
+* **blocking-under-lock** — a blocking socket operation (``accept``,
+  ``recv``, ``sendall``, ``connect``, ``create_connection``) or
+  ``time.sleep`` while holding a lock. Failure mode: every thread that
+  needs the lock stalls behind one slow peer's TCP timeout — the
+  protocol tick inherits network tail latency.
+
+Methods never reached from a thread target (constructors, the
+protocol thread's own setup) are exempt from unlocked-write: before
+the threads exist there is nothing to race.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "concurrency"
+
+SCOPE_PREFIXES = ("minpaxos_tpu/runtime/transport.py",
+                  "minpaxos_tpu/runtime/master.py",
+                  "minpaxos_tpu/cli/")
+
+_MUTATORS = frozenset({"append", "extend", "insert", "pop", "popitem",
+                       "update", "clear", "remove", "discard", "add",
+                       "setdefault", "sort", "reverse"})
+_BLOCKING_ATTRS = frozenset({"accept", "recv", "recv_into", "recvfrom",
+                             "sendall", "connect", "connect_ex",
+                             "create_connection"})
+
+
+def _is_self_attr(node: ast.expr) -> str | None:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_expr(node: ast.expr) -> bool:
+    """`self._lock`-ish: an attribute or name with 'lock' in it."""
+    if isinstance(node, ast.Attribute):
+        return "lock" in node.attr.lower()
+    return isinstance(node, ast.Name) and "lock" in node.id.lower()
+
+
+def _with_holds_lock(node: ast.With) -> bool:
+    return any(_is_lock_expr(item.context_expr) for item in node.items)
+
+
+def _uses_manual_lock(method: ast.FunctionDef) -> bool:
+    """Does the method call `<lock>.acquire()` anywhere? Manual
+    acquire/release flow (e.g. acquire with a timeout) can't be scoped
+    lexically, so the unlocked-write check stands down for the whole
+    method rather than report false races on a correctly guarded
+    pattern."""
+    for node in ast.walk(method):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+                and _is_lock_expr(node.func.value)):
+            return True
+    return False
+
+
+def _thread_targets(tree: ast.AST) -> set[str]:
+    """Names of methods/functions passed as Thread(target=...)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_thread = (isinstance(f, ast.Attribute) and f.attr == "Thread") \
+            or (isinstance(f, ast.Name) and f.id == "Thread")
+        if not is_thread:
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            name = _is_self_attr(kw.value)
+            if name is not None:
+                out.add(name)
+            elif isinstance(kw.value, ast.Name):
+                out.add(kw.value.id)
+    return out
+
+
+class _ClassFacts:
+    def __init__(self, cls: ast.ClassDef):
+        self.cls = cls
+        self.methods: dict[str, ast.FunctionDef] = {
+            n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)}
+        # attrs the class itself protects with its lock, anywhere
+        self.guarded: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.With) and _with_holds_lock(node):
+                for sub in node.body:
+                    for n in ast.walk(sub):
+                        attr = _is_self_attr(n)
+                        if attr is not None and "lock" not in attr.lower():
+                            self.guarded.add(attr)
+
+    def reachable_from(self, roots: set[str]) -> set[str]:
+        """Methods reachable from thread-target methods via self calls
+        (including Thread targets spawned inside them)."""
+        seen: set[str] = set()
+        work = [r for r in roots if r in self.methods]
+        while work:
+            name = work.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for node in ast.walk(self.methods[name]):
+                if isinstance(node, ast.Call):
+                    callee = None
+                    if isinstance(node.func, ast.Attribute):
+                        callee = _is_self_attr(node.func)
+                    if callee in self.methods and callee not in seen:
+                        work.append(callee)
+        return seen
+
+
+def _write_targets(node: ast.stmt):
+    """(attr name, line) for each self-attribute write in a statement."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            base = t
+            while isinstance(base, (ast.Subscript, ast.Starred)):
+                base = base.value
+            attr = _is_self_attr(base)
+            if attr is not None:
+                yield attr, t.lineno
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _is_self_attr(base)
+            if attr is not None:
+                yield attr, t.lineno
+    elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        f = node.value.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = _is_self_attr(f.value)
+            if attr is not None:
+                yield attr, node.lineno
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walks one method tracking lock depth."""
+
+    def __init__(self, path: str, method: str, guarded: set[str],
+                 check_writes: bool, out: list[Violation]):
+        self.path = path
+        self.method = method
+        self.guarded = guarded
+        self.check_writes = check_writes
+        self.out = out
+        self.depth = 0
+
+    def visit_With(self, node: ast.With) -> None:
+        held = _with_holds_lock(node)
+        for item in node.items:
+            self.visit(item.context_expr)
+        if held:
+            self.depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if held:
+            self.depth -= 1
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass  # nested defs get their own analysis context
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.depth > 0:
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            is_sleep = name == "sleep" and (
+                isinstance(f, ast.Name)
+                or (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "time"))
+            if name in _BLOCKING_ATTRS or is_sleep:
+                self.out.append(Violation(
+                    self.path, node.lineno, RULE,
+                    f"blocking call `{name}` while holding a lock in "
+                    f"`{self.method}` — every thread needing the lock "
+                    "stalls behind this peer's TCP timeout"))
+        self.generic_visit(node)
+
+    def generic_visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.stmt) and self.check_writes \
+                and self.depth == 0:
+            for attr, line in _write_targets(node):
+                if attr in self.guarded:
+                    self.out.append(Violation(
+                        self.path, line, RULE,
+                        f"write to lock-guarded `self.{attr}` in thread-"
+                        f"reachable `{self.method}` without holding the "
+                        "lock — races the locked readers/writers"))
+        super().generic_visit(node)
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files.values():
+        if f.tree is None or not f.path.startswith(SCOPE_PREFIXES):
+            continue
+        targets = _thread_targets(f.tree)
+        for node in f.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            facts = _ClassFacts(node)
+            hot = facts.reachable_from(targets | _thread_targets(node))
+            for name, method in facts.methods.items():
+                checker = _MethodChecker(
+                    f.path, name, facts.guarded,
+                    check_writes=(name in hot
+                                  and not _uses_manual_lock(method)),
+                    out=out)
+                for stmt in method.body:
+                    checker.visit(stmt)
+    return out

@@ -1,0 +1,181 @@
+"""paxlint core: violations, the project model, suppressions, registry.
+
+Deliberately dependency-light: the AST passes must run in CI without a
+JAX import (tools/run_tier1.sh invokes the linter before pytest, on
+CPU, cold), so this package imports only the standard library plus
+numpy — and loads repo modules it needs to *evaluate* (wire schemas)
+by file path, never through ``import minpaxos_tpu.x`` (package
+``__init__``s pull in jax).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# -- violations ----------------------------------------------------------
+
+
+@dataclass(frozen=True, order=True)
+class Violation:
+    """One rule firing at one source location."""
+
+    path: str  # repo-root-relative, forward slashes
+    line: int  # 1-based
+    rule: str  # e.g. "trace-hazard"
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+    def as_json(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "msg": self.msg}
+
+
+# -- suppressions --------------------------------------------------------
+
+# same-line:  <code>  # paxlint: disable=rule1,rule2 [-- reason]
+# on a comment-only line, the directive covers the next code line;
+# anywhere (conventionally the top):  # paxlint: disable-file=rule
+_SUPPRESS_RE = re.compile(
+    r"#\s*paxlint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+def _parse_suppressions(src: str) -> tuple[dict[int, set[str]], set[str]]:
+    """(line -> suppressed rules, file-wide suppressed rules)."""
+    per_line: dict[int, set[str]] = {}
+    per_file: set[str] = set()
+    lines = src.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        # everything after ` -- ` is the human reason, not a rule name
+        spec = re.split(r"\s+--(?:\s|$)", m.group(2))[0]
+        rules = {r.strip() for r in spec.split(",") if r.strip()}
+        if m.group(1) == "disable-file":
+            per_file |= rules
+            continue
+        per_line.setdefault(i, set()).update(rules)
+        if text.lstrip().startswith("#"):
+            # comment-only directive: also covers the next code line
+            # (skipping further comment-only / blank lines in between)
+            j = i  # 0-based index of the line after i
+            while j < len(lines) and (not lines[j].strip()
+                                      or lines[j].lstrip().startswith("#")):
+                j += 1
+            if j < len(lines):
+                per_line.setdefault(j + 1, set()).update(rules)
+    return per_line, per_file
+
+
+# -- project model -------------------------------------------------------
+
+
+@dataclass
+class SourceFile:
+    path: str  # repo-root-relative
+    src: str
+    tree: ast.Module | None = None
+    error: str | None = None  # syntax error, reported as a violation
+    suppress_lines: dict[int, set[str]] = field(default_factory=dict)
+    suppress_file: set[str] = field(default_factory=set)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if rule in self.suppress_file or "all" in self.suppress_file:
+            return True
+        rules = self.suppress_lines.get(line, ())
+        return rule in rules or "all" in rules
+
+
+class Project:
+    """The lintable tree: repo-relative path -> parsed source.
+
+    Tests build fixture projects from literal dicts; the CLI builds one
+    from the repo root. Passes see only this object, so a seeded
+    violation and a real one travel the same code path.
+    """
+
+    def __init__(self, files: dict[str, str], root: Path | None = None):
+        self.root = root
+        self.files: dict[str, SourceFile] = {}
+        for path, src in sorted(files.items()):
+            path = path.replace("\\", "/")
+            f = SourceFile(path=path, src=src)
+            try:
+                f.tree = ast.parse(src, filename=path)
+            except SyntaxError as e:
+                f.error = f"syntax error: {e.msg} (line {e.lineno})"
+            f.suppress_lines, f.suppress_file = _parse_suppressions(src)
+            self.files[path] = f
+
+    @classmethod
+    def from_root(cls, root: str | Path,
+                  subdirs: tuple[str, ...] = ("minpaxos_tpu",)) -> "Project":
+        root = Path(root).resolve()
+        files: dict[str, str] = {}
+        for sub in subdirs:
+            base = root / sub
+            if not base.exists():
+                continue
+            for p in sorted(base.rglob("*.py")):
+                rel = p.relative_to(root).as_posix()
+                files[rel] = p.read_text(encoding="utf-8")
+        return cls(files, root=root)
+
+    def glob(self, prefix: str) -> list[SourceFile]:
+        """Files under a path prefix (e.g. "minpaxos_tpu/ops/")."""
+        return [f for p, f in self.files.items() if p.startswith(prefix)]
+
+    def get(self, path: str) -> SourceFile | None:
+        return self.files.get(path)
+
+
+# -- pass registry -------------------------------------------------------
+
+#: rule name -> pass function ``(Project) -> list[Violation]``
+PASSES: dict[str, object] = {}
+
+
+def register(rule: str):
+    """Register a pass under its rule name (the name used in
+    ``# paxlint: disable=<rule>`` and ``--rules``)."""
+
+    def deco(fn):
+        fn.rule = rule
+        PASSES[rule] = fn
+        return fn
+
+    return deco
+
+
+def run_passes(project: Project,
+               rules: tuple[str, ...] | None = None) -> list[Violation]:
+    """Run the selected passes (default: all), apply suppressions,
+    return sorted, de-duplicated violations. A file that does not
+    parse is itself a violation (every pass needs the AST)."""
+    out: set[Violation] = set()
+    for f in project.files.values():
+        if f.error is not None:
+            out.add(Violation(f.path, 1, "parse", f.error))
+    selected = rules if rules is not None else tuple(PASSES)
+    for rule in selected:
+        if rule not in PASSES:
+            raise KeyError(f"unknown paxlint rule {rule!r}; "
+                           f"known: {', '.join(sorted(PASSES))}")
+        for v in PASSES[rule](project):
+            f = project.get(v.path)
+            if f is not None and f.suppressed(v.line, v.rule):
+                continue
+            out.add(v)
+    # one violation per (path, line, rule): a single defect can trip
+    # two checks of the same pass (e.g. trace-hazard's reachability
+    # rule AND its ops/-package rule on one np.asarray) — double
+    # counting would skew the --json counts benches track
+    dedup: dict[tuple[str, int, str], Violation] = {}
+    for v in sorted(out):
+        dedup.setdefault((v.path, v.line, v.rule), v)
+    return sorted(dedup.values())

@@ -1,0 +1,434 @@
+"""Shared jit reachability + taint machinery for the JAX passes.
+
+Builds, from ASTs alone (no jax import):
+
+* the set of module-level functions in the device packages,
+* the jit *wrap sites* (``@jax.jit``, ``@partial(jax.jit, ...)``,
+  ``name = jax.jit(f, ...)``) with their static argnums/argnames,
+* a call-graph fixed point that propagates *taint* — "this parameter
+  receives a traced value" — from each jit entry point through
+  resolvable calls. Static parameters (``static_argnums`` /
+  ``static_argnames``, plus the conventional ``cfg``/``config``
+  config-carrier names) start untainted; everything else a jit entry
+  receives is a tracer. At a call site, a callee parameter is tainted
+  iff some analyzed caller passes it a tainted expression.
+
+The taint judgment is *value* taint: structural reads that never force
+a device sync (``x.shape``, ``x.ndim``, ``x is None``, ``len(x)``,
+``hasattr``/``isinstance``) launder taint away, because branching on
+them is legitimate trace-time metaprogramming.
+
+Class bodies are deliberately ignored: in this repo's architecture
+methods are host-side drivers (models/cluster.py, the in-module test
+harnesses), and jitted code is module-level functions.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+# attribute reads that yield plain Python values on tracers (shape
+# metadata, NamedTuple structure) — branching on these is trace-time
+# metaprogramming, not a host sync
+STRUCTURAL_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "itemsize", "_fields"})
+# builtins whose result is a plain Python value even on traced args
+STRUCTURAL_CALLS = frozenset(
+    {"hasattr", "isinstance", "issubclass", "callable", "len", "type"})
+# parameter names conventionally carrying static config pytrees
+STATIC_PARAM_NAMES = frozenset({"cfg", "config"})
+
+FuncKey = tuple[str, str]  # (file path, function name)
+
+
+@dataclass
+class FuncInfo:
+    key: FuncKey
+    node: ast.FunctionDef
+    params: list[str]
+    n_defaults: int = 0
+
+
+@dataclass
+class JitWrap:
+    """One jit wrap site: which function, which params are static."""
+
+    target: FuncKey
+    line: int
+    path: str
+    static_params: set[str] = field(default_factory=set)
+    static_argnums: list[int] = field(default_factory=list)
+
+
+@dataclass
+class Module:
+    path: str
+    tree: ast.Module
+    functions: dict[str, FuncInfo] = field(default_factory=dict)
+    # local name -> ("module path-ish dotted name", remote name or None)
+    imports: dict[str, tuple[str, str | None]] = field(default_factory=dict)
+    # module-level names bound to mutable literals (list/dict/set)
+    mutable_globals: dict[str, int] = field(default_factory=dict)  # name->line
+
+
+def _dotted(node: ast.expr) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_expr(node: ast.expr, imports: dict) -> bool:
+    """Does this expression denote jax.jit (under any local alias)?"""
+    d = _dotted(node)
+    if d is None:
+        return False
+    head = d.split(".", 1)[0]
+    mod, name = imports.get(head, (head, None))
+    full = mod + ("." + d.split(".", 1)[1] if "." in d else "")
+    if name is not None:  # `from jax import jit [as j]`
+        full = f"{mod}.{name}"
+    return full in ("jax.jit", "jax.api.jit", "jit")
+
+
+def _is_partial(node: ast.expr, imports: dict) -> bool:
+    d = _dotted(node)
+    if d is None:
+        return False
+    head = d.split(".", 1)[0]
+    mod, name = imports.get(head, (head, None))
+    if name is not None:
+        return f"{mod}.{name}" == "functools.partial"
+    full = mod + ("." + d.split(".", 1)[1] if "." in d else "")
+    return full in ("functools.partial", "partial")
+
+
+def _static_from_kwargs(keywords: list[ast.keyword],
+                        params: list[str]) -> tuple[set[str], list[int]]:
+    names: set[str] = set()
+    nums: list[int] = []
+    for kw in keywords:
+        if kw.arg == "static_argnums":
+            for v in _const_ints(kw.value):
+                nums.append(v)
+                if 0 <= v < len(params):
+                    names.add(params[v])
+        elif kw.arg == "static_argnames":
+            for s in _const_strs(kw.value):
+                names.add(s)
+    return names, nums
+
+
+def _const_ints(node: ast.expr) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return out
+    return []
+
+
+def _const_strs(node: ast.expr) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+_MUTABLE_CTORS = frozenset({"list", "dict", "set", "bytearray"})
+
+
+def _is_mutable_literal(node: ast.expr) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _MUTABLE_CTORS
+    return False
+
+
+def parse_module(path: str, tree: ast.Module) -> Module:
+    m = Module(path=path, tree=tree)
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                m.imports[a.asname or a.name.split(".")[0]] = (a.name, None)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                m.imports[a.asname or a.name] = (node.module, a.name)
+        elif isinstance(node, ast.FunctionDef):
+            args = node.args
+            params = ([a.arg for a in args.posonlyargs]
+                      + [a.arg for a in args.args]
+                      + [a.arg for a in args.kwonlyargs])
+            m.functions[node.name] = FuncInfo(
+                key=(path, node.name), node=node, params=params,
+                n_defaults=len(args.defaults))
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and _is_mutable_literal(node.value):
+                    m.mutable_globals[t.id] = node.lineno
+    return m
+
+
+def find_jit_wraps(m: Module) -> list[JitWrap]:
+    """All jit wrap sites in one module: decorators on module-level
+    functions, plus ``jit(f, ...)`` call-wraps anywhere (module level
+    or inside factory functions)."""
+    wraps: list[JitWrap] = []
+    for node in m.tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        fi = m.functions[node.name]
+        for dec in node.decorator_list:
+            if is_jit_expr(dec, m.imports):
+                wraps.append(JitWrap(fi.key, node.lineno, m.path))
+            elif (isinstance(dec, ast.Call)
+                  and is_jit_expr(dec.func, m.imports)):
+                names, nums = _static_from_kwargs(dec.keywords, fi.params)
+                wraps.append(JitWrap(fi.key, node.lineno, m.path,
+                                     names, nums))
+            elif (isinstance(dec, ast.Call)
+                  and _is_partial(dec.func, m.imports) and dec.args
+                  and is_jit_expr(dec.args[0], m.imports)):
+                names, nums = _static_from_kwargs(dec.keywords, fi.params)
+                wraps.append(JitWrap(fi.key, node.lineno, m.path,
+                                     names, nums))
+    for call in ast.walk(m.tree):
+        if (isinstance(call, ast.Call)
+                and is_jit_expr(call.func, m.imports) and call.args
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in m.functions):
+            fi = m.functions[call.args[0].id]
+            names, nums = _static_from_kwargs(call.keywords, fi.params)
+            wraps.append(JitWrap(fi.key, call.lineno, m.path, names, nums))
+    return wraps
+
+
+class Graph:
+    """Project-wide jit reachability with per-parameter taint."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, Module] = {}
+        self.wraps: list[JitWrap] = []
+        #: FuncKey -> set of tainted parameter names (monotone)
+        self.taint: dict[FuncKey, set[str]] = {}
+        self._by_modname: dict[str, Module] = {}
+
+    # -- construction --
+
+    @classmethod
+    def build(cls, project, prefixes: tuple[str, ...]) -> "Graph":
+        # the trace and recompile passes build over the same prefixes;
+        # cache the fixed point on the project so one lint invocation
+        # pays for it once
+        cache = getattr(project, "_jitgraph_cache", None)
+        if cache is None:
+            cache = project._jitgraph_cache = {}
+        if prefixes in cache:
+            return cache[prefixes]
+        g = cls()
+        for prefix in prefixes:
+            for f in project.glob(prefix):
+                if f.tree is None or f.path in g.modules:
+                    continue
+                m = parse_module(f.path, f.tree)
+                g.modules[f.path] = m
+                g._by_modname[_modname(f.path)] = m
+        for m in g.modules.values():
+            g.wraps.extend(find_jit_wraps(m))
+        g._propagate()
+        cache[prefixes] = g
+        return g
+
+    # -- call resolution --
+
+    def resolve_call(self, m: Module, func: ast.expr) -> FuncInfo | None:
+        """Resolve a call target to an analyzed module-level function."""
+        if isinstance(func, ast.Name):
+            if func.id in m.functions:
+                return m.functions[func.id]
+            imp = m.imports.get(func.id)
+            if imp is not None and imp[1] is not None:
+                target = self._by_modname.get(imp[0])
+                if target is not None:
+                    return target.functions.get(imp[1])
+        elif (isinstance(func, ast.Attribute)
+              and isinstance(func.value, ast.Name)):
+            imp = m.imports.get(func.value.id)
+            if imp is not None and imp[1] is None:
+                target = self._by_modname.get(imp[0])
+                if target is not None:
+                    return target.functions.get(func.attr)
+        return None
+
+    # -- taint --
+
+    def _propagate(self) -> None:
+        work: list[FuncKey] = []
+        for w in self.wraps:
+            m = self.modules.get(w.target[0])
+            fi = m.functions.get(w.target[1]) if m else None
+            if fi is None:
+                continue
+            tainted = {p for p in fi.params
+                       if p not in w.static_params
+                       and p not in STATIC_PARAM_NAMES}
+            if self._merge(fi.key, tainted):
+                work.append(fi.key)
+        while work:
+            key = work.pop()
+            m = self.modules[key[0]]
+            fi = m.functions[key[1]]
+            for callee, tainted in self._call_edges(m, fi):
+                if self._merge(callee.key, tainted):
+                    work.append(callee.key)
+
+    def _merge(self, key: FuncKey, tainted: set[str]) -> bool:
+        cur = self.taint.get(key)
+        if cur is None:
+            self.taint[key] = set(tainted)
+            return True
+        if tainted - cur:
+            cur |= tainted
+            return True
+        return False
+
+    def _call_edges(self, m: Module, fi: FuncInfo):
+        """(callee, tainted callee params) for each resolvable call in
+        ``fi``'s body, under ``fi``'s current taint."""
+        tainted_locals = local_taint(fi, self.taint.get(fi.key, set()))
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_call(m, node.func)
+            if callee is None or callee.key == fi.key:
+                continue
+            t: set[str] = set()
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred):
+                    continue
+                if i < len(callee.params) and value_tainted(a, tainted_locals):
+                    t.add(callee.params[i])
+            for kw in node.keywords:
+                if kw.arg is not None and value_tainted(kw.value,
+                                                        tainted_locals):
+                    t.add(kw.arg)
+            t &= set(callee.params)
+            yield callee, t
+
+    def reachable(self) -> dict[FuncKey, set[str]]:
+        return self.taint
+
+
+def _modname(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+# -- expression-level taint ---------------------------------------------
+
+
+def value_tainted(node: ast.expr, tainted: set[str]) -> bool:
+    """Could evaluating this expression's *value* observe a traced
+    array (so that ``if``/``int()``/iteration on it forces a sync)?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in STRUCTURAL_ATTRS:
+            return False
+        return value_tainted(node.value, tainted)
+    if isinstance(node, ast.Subscript):
+        return (value_tainted(node.value, tainted)
+                or value_tainted(node.slice, tainted))
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False  # identity checks never sync
+        return (value_tainted(node.left, tainted)
+                or any(value_tainted(c, tainted) for c in node.comparators))
+    if isinstance(node, (ast.BoolOp,)):
+        return any(value_tainted(v, tainted) for v in node.values)
+    if isinstance(node, ast.BinOp):
+        return (value_tainted(node.left, tainted)
+                or value_tainted(node.right, tainted))
+    if isinstance(node, ast.UnaryOp):
+        return value_tainted(node.operand, tainted)
+    if isinstance(node, ast.IfExp):
+        return (value_tainted(node.test, tainted)
+                or value_tainted(node.body, tainted)
+                or value_tainted(node.orelse, tainted))
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name):
+            if node.func.id in STRUCTURAL_CALLS:
+                return False
+            if node.func.id == "getattr" and node.args:
+                return value_tainted(node.args[0], tainted)
+        return (value_tainted(node.func, tainted)
+                or any(value_tainted(a, tainted) for a in node.args
+                       if not isinstance(a, ast.Starred))
+                or any(value_tainted(kw.value, tainted)
+                       for kw in node.keywords))
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(value_tainted(e, tainted) for e in node.elts)
+    if isinstance(node, ast.Starred):
+        return value_tainted(node.value, tainted)
+    if isinstance(node, ast.Slice):
+        return any(value_tainted(p, tainted)
+                   for p in (node.lower, node.upper, node.step)
+                   if p is not None)
+    return False  # constants, lambdas, comprehensions, f-strings, ...
+
+
+def local_taint(fi: FuncInfo, tainted_params: set[str]) -> set[str]:
+    """Tainted local names for a function body: tainted params plus
+    anything assigned from a tainted expression (two fixed-point
+    sweeps cover the straight-line chains kernels actually contain)."""
+    tainted = set(tainted_params) & set(fi.params)
+    # nested functions and lambdas are scan/cond/vmap bodies: their
+    # parameters receive tracers by construction
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.FunctionDef) and node is not fi.node:
+            tainted.update(a.arg for a in node.args.args)
+        elif isinstance(node, ast.Lambda):
+            tainted.update(a.arg for a in node.args.args)
+    for _ in range(2):
+        before = len(tainted)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign):
+                if value_tainted(node.value, tainted):
+                    for t in node.targets:
+                        _taint_target(t, tainted)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None and value_tainted(node.value,
+                                                            tainted):
+                    _taint_target(node.target, tainted)
+            elif isinstance(node, ast.For):
+                if value_tainted(node.iter, tainted):
+                    _taint_target(node.target, tainted)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+def _taint_target(t: ast.expr, tainted: set[str]) -> None:
+    if isinstance(t, ast.Name):
+        tainted.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _taint_target(e, tainted)
+    elif isinstance(t, ast.Starred):
+        _taint_target(t.value, tainted)

@@ -1,0 +1,133 @@
+"""recompile-hazard: things that silently retrace or split the cache.
+
+Production failure mode: a retrace is 10-40 s of XLA compilation on
+this repo's hosts (utils/backend.py compile-cache note) — mid-traffic
+that reads as a wedged replica, triggers client retry storms and
+spurious elections. The causes are all visible statically:
+
+* **mutable default arguments** on functions in the JAX packages — a
+  ``def step(x, buf=[])`` default is created once and mutated across
+  calls, so the traced constant drifts from reality (and equality-
+  based jit caching can't see it);
+* **unhashable static arguments** — a parameter marked
+  ``static_argnums``/``static_argnames`` whose default is a
+  list/dict/set, or whose annotation says it is an array: jit raises
+  at call time (or retraces per call when the value's hash changes);
+* **jit closures over mutable module globals** — a jitted function
+  reading a module-level list/dict/set bakes the value at trace time;
+  later mutation silently diverges device behavior from host intent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from minpaxos_tpu.analysis import jitgraph
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "recompile-hazard"
+
+PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/",
+            "minpaxos_tpu/runtime/", "minpaxos_tpu/parallel/")
+
+_ARRAYISH = ("ndarray", "Array", "DeviceArray")
+
+
+def _annotation_is_array(ann: ast.expr | None) -> bool:
+    if ann is None:
+        return False
+    text = ast.unparse(ann)
+    return any(a in text for a in _ARRAYISH)
+
+
+def _mutable_defaults(fn: ast.FunctionDef):
+    """(param name, default node) pairs with mutable literal defaults."""
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    for param, default in zip(pos[len(pos) - len(args.defaults):],
+                              args.defaults):
+        if jitgraph._is_mutable_literal(default):
+            yield param.arg, default
+    for param, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None and jitgraph._is_mutable_literal(default):
+            yield param.arg, default
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    graph = jitgraph.Graph.build(project, PREFIXES)
+    out: list[Violation] = []
+
+    # R1: mutable defaults on any module-level function in the JAX
+    # packages (jit-reachable ones retrace; the rest are shared-state
+    # bugs waiting to be called twice)
+    for m in graph.modules.values():
+        for fi in m.functions.values():
+            for pname, default in _mutable_defaults(fi.node):
+                out.append(Violation(
+                    m.path, default.lineno, RULE,
+                    f"mutable default for `{pname}` in `{fi.key[1]}` — "
+                    "created once, shared across calls; jit caching "
+                    "cannot see its mutation"))
+
+    # R2: static params that cannot be hashed
+    for w in graph.wraps:
+        m = graph.modules.get(w.path)
+        fi = m.functions.get(w.target[1]) if m else None
+        if fi is None:
+            continue
+        bad_defaults = dict(_mutable_defaults(fi.node))
+        ann_by_param = {a.arg: a.annotation
+                        for a in fi.node.args.posonlyargs
+                        + fi.node.args.args + fi.node.args.kwonlyargs}
+        for pname in sorted(w.static_params):
+            if pname in bad_defaults:
+                out.append(Violation(
+                    w.path, w.line, RULE,
+                    f"static param `{pname}` of `{w.target[1]}` has an "
+                    "unhashable (mutable) default — jit raises at call "
+                    "time"))
+            elif _annotation_is_array(ann_by_param.get(pname)):
+                out.append(Violation(
+                    w.path, w.line, RULE,
+                    f"static param `{pname}` of `{w.target[1]}` is "
+                    "annotated as an array — arrays are unhashable; "
+                    "pass it traced or make it a static scalar"))
+        for i in w.static_argnums:
+            if not 0 <= i < len(fi.params):
+                out.append(Violation(
+                    w.path, w.line, RULE,
+                    f"static_argnums index {i} is out of range for "
+                    f"`{w.target[1]}` ({len(fi.params)} params)"))
+
+    # R3: jit-reachable functions reading mutable module globals
+    reachable = graph.reachable()
+    for key in reachable:
+        path, name = key
+        m = graph.modules.get(path)
+        if m is None or name not in m.functions:
+            continue
+        fi = m.functions[name]
+        local_names = set(fi.params)
+        for node in ast.walk(fi.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgt = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgt:
+                    jitgraph._taint_target(t, local_names)
+        seen: set[str] = set()
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in m.mutable_globals
+                    and node.id not in local_names
+                    and node.id not in seen):
+                seen.add(node.id)
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    f"jit-reachable `{name}` closes over mutable module "
+                    f"global `{node.id}` (defined line "
+                    f"{m.mutable_globals[node.id]}) — its value is "
+                    "baked at trace time; later mutation silently "
+                    "diverges"))
+    return out

@@ -1,0 +1,164 @@
+"""trace-hazard: host syncs and Python control flow on traced values.
+
+Production failure mode: a host sync inside the jitted protocol step
+serializes every tick behind a device round-trip — the accidental
+stalls that dominate Paxos tail latency in deployment studies (PAPERS
+arxiv 1404.6719) — and a Python branch on a traced value either
+crashes at trace time or silently splits the compile cache.
+
+Two layers:
+
+* **jit-reachable checks** — for every function reachable from a jit
+  wrap site (anywhere in ops/, models/, runtime/, parallel/), with
+  per-parameter taint from the call graph (jitgraph.py): flag
+  ``.item()``, ``int()/float()/bool()`` coercions of traced values,
+  ``np.asarray``-family calls on traced values, and ``if``/``while``/
+  ``for`` driven by traced values. Structural reads (``.shape``,
+  ``is None``, ``len``) are exempt — that is trace-time
+  metaprogramming, not a sync.
+* **device-package rule** — in ``ops/`` (the device-kernel package,
+  per the package docstring), *any* numpy array construction inside a
+  module-level function is flagged, reachable or not: host-side
+  helpers that legitimately live there (the 64-bit lane splitters in
+  ops/packed.py) must carry an explicit
+  ``# paxlint: disable=trace-hazard`` so the host/device boundary is
+  visible in the source.
+
+Violations are only *reported* in ops/ and models/ — runtime/ and
+parallel/ participate in the call graph so reachability into
+ops/substeps.py from the runtime's jit entry points is seen, but those
+packages are host-orchestration code reviewed under different rules.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from minpaxos_tpu.analysis import jitgraph
+from minpaxos_tpu.analysis.core import Project, Violation, register
+from minpaxos_tpu.analysis.jitgraph import value_tainted
+
+RULE = "trace-hazard"
+
+GRAPH_PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/",
+                  "minpaxos_tpu/runtime/", "minpaxos_tpu/parallel/")
+REPORT_PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/")
+DEVICE_PACKAGE = "minpaxos_tpu/ops/"
+
+_NP_CTORS = frozenset({"asarray", "array", "frombuffer",
+                       "ascontiguousarray", "copyto"})
+_ITER_WRAPPERS = frozenset({"range", "zip", "enumerate", "reversed",
+                            "sorted"})
+
+
+def _numpy_ctor(call: ast.Call, m: jitgraph.Module) -> str | None:
+    """'np.asarray'-style label if this call constructs a numpy array
+    (under whatever local alias numpy was imported as), else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        imp = m.imports.get(f.value.id)
+        if imp == ("numpy", None) and f.attr in _NP_CTORS:
+            return f"{f.value.id}.{f.attr}"
+    elif isinstance(f, ast.Name):
+        imp = m.imports.get(f.id)
+        if imp is not None and imp[0] == "numpy" and imp[1] in _NP_CTORS:
+            return f.id
+    return None
+
+
+def _iter_hazard(node: ast.expr, tainted: set[str]) -> bool:
+    """Does this ``for`` iterable force concretization? Bare traced
+    names/attribute chains and ``range()`` over traced values do;
+    method calls (``state._asdict().items()``) iterate *containers* of
+    tracers, which is fine."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, (ast.Attribute, ast.Subscript)):
+        return value_tainted(node, tainted)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in _ITER_WRAPPERS:
+        return any(value_tainted(a, tainted) for a in node.args)
+    return False
+
+
+def _check_function(m: jitgraph.Module, fi: jitgraph.FuncInfo,
+                    tainted_params: set[str],
+                    out: list[Violation]) -> None:
+    tainted = jitgraph.local_taint(fi, tainted_params)
+    path = m.path
+
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "item"
+                    and not node.args
+                    and value_tainted(f.value, tainted)):
+                # taint-gated like the other checks: .item() on a
+                # static-config scalar is trace-time metaprogramming
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    "`.item()` forces a host sync inside jit-reachable "
+                    "code — every protocol tick stalls on a device "
+                    "round-trip"))
+                continue
+            label = _numpy_ctor(node, m)
+            if label is not None and any(
+                    value_tainted(a, tainted) for a in node.args):
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    f"`{label}` on a traced value pulls it to the host "
+                    "inside jit-reachable code (tick stall / trace "
+                    "error)"))
+                continue
+            if (isinstance(f, ast.Name) and f.id in ("int", "float", "bool")
+                    and any(value_tainted(a, tainted) for a in node.args)):
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    f"`{f.id}()` coercion of a traced value forces a "
+                    "host sync inside jit-reachable code"))
+        elif isinstance(node, (ast.If, ast.While)):
+            kw = "if" if isinstance(node, ast.If) else "while"
+            if value_tainted(node.test, tainted):
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    f"Python `{kw}` on a traced value inside "
+                    "jit-reachable code — branch on static config or "
+                    "use `jnp.where`/`lax.cond`"))
+        elif isinstance(node, ast.For):
+            if _iter_hazard(node.iter, tainted):
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    "Python `for` over a traced value inside "
+                    "jit-reachable code — use `lax.scan`/`fori_loop`"))
+
+
+def _check_device_package(m: jitgraph.Module,
+                          out: list[Violation]) -> None:
+    """ops/ package rule: any numpy array construction in a
+    module-level function needs a suppression marking it host-side."""
+    for fi in m.functions.values():
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                label = _numpy_ctor(node, m)
+                if label is not None:
+                    out.append(Violation(
+                        m.path, node.lineno, RULE,
+                        f"`{label}` in the device-kernel package ops/ — "
+                        "host-side helpers must carry `# paxlint: "
+                        "disable=trace-hazard` with a reason"))
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    graph = jitgraph.Graph.build(project, GRAPH_PREFIXES)
+    out: list[Violation] = []
+    for key, tainted in graph.reachable().items():
+        path, name = key
+        if not path.startswith(REPORT_PREFIXES):
+            continue
+        m = graph.modules[path]
+        _check_function(m, m.functions[name], tainted, out)
+    for path, m in graph.modules.items():
+        if path.startswith(DEVICE_PACKAGE):
+            _check_device_package(m, out)
+    return out

@@ -1,0 +1,82 @@
+"""wall-honesty: stall/retry counters must advance by ``tick_inc``.
+
+PR 1's fused-substep machinery (ops/substeps.py) runs k protocol
+substeps per device dispatch: one *wall* tick now executes as k kernel
+steps, with only substep 0 carrying ``tick_inc=1``. Every counter that
+gates on "ticks of silence" — ``stall_ticks`` driving accept retries,
+gap no-op fills and Mencius takeover sweeps, plus the global ``tick``
+that paces frontier gossip — must therefore advance by the
+``tick_inc`` argument, never by a literal.
+
+Production failure mode of a ``+ 1``: a fused k=3 burst ages the stall
+counter 3x faster than wall time, so the retry/takeover thresholds
+(calibrated in wall ticks — see the ``-noopdelay`` flag's churn note
+in cli/server.py) fire k times early; under load that is a
+ballot-bump/re-drive storm, the exact collapse the round-5 bench hit.
+
+Mechanically: in models/*.py, any ``+``/``-`` expression over an
+attribute whose name says it counts ticks/stalls/retries must mention
+``tick_inc`` somewhere in that expression. Config-carried thresholds
+(``cfg.noop_delay``, ``cfg.gossip_ticks``) are not counters and are
+exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "wall-honesty"
+
+SCOPE_PREFIX = "minpaxos_tpu/models/"
+
+# counter-ish attribute names: 'tick', 'stall_ticks', 'retry_count', ...
+_COUNTER_RE = re.compile(
+    r"(?:^|_)(?:tick|ticks|stall|stalls|retry|retries|silence)(?:_|$)")
+# names that LOOK counter-ish but are static config/arguments
+_EXEMPT_ATTRS = frozenset({"tick_inc", "gossip_ticks", "noop_delay",
+                           "fuse_ticks", "tick_s"})
+_EXEMPT_BASES = frozenset({"cfg", "config", "flags", "self"})
+
+
+def _counter_attr(node: ast.expr) -> str | None:
+    """'state.stall_ticks'-style counter read, else None."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    if node.attr in _EXEMPT_ATTRS or not _COUNTER_RE.search(node.attr):
+        return None
+    base = node.value
+    if isinstance(base, ast.Name) and base.id in _EXEMPT_BASES:
+        return None
+    return node.attr
+
+
+def _mentions_tick_inc(node: ast.expr) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == "tick_inc"
+               for n in ast.walk(node))
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files.values():
+        if f.tree is None or not f.path.startswith(SCOPE_PREFIX):
+            continue
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, (ast.Add, ast.Sub))):
+                continue
+            attr = _counter_attr(node.left) or _counter_attr(node.right)
+            if attr is None:
+                continue
+            if _mentions_tick_inc(node):
+                continue
+            out.append(Violation(
+                f.path, node.lineno, RULE,
+                f"counter `{attr}` updated without `tick_inc` — under "
+                "fused substeps (ops/substeps.py) it ages k times "
+                "faster than wall time, firing stall/retry/takeover "
+                "thresholds early"))
+    return out

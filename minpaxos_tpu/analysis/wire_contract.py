@@ -1,0 +1,237 @@
+"""wire-contract: opcode registry and packed-width drift detection.
+
+Production failure mode: frames are headerless packed structs — a
+renumbered opcode or a resized field doesn't error, it *reinterprets
+bytes*: a v2 replica decodes a v1 ACCEPT's ballot as half a key,
+acks garbage, and the corruption is consensus-durable. RMWPaxos
+(arxiv 2001.03362) argues exactly this class of property should be
+checked mechanically; here the check is three-way:
+
+1. **collision-free** — no two ``MsgKind`` members share a value
+   (IntEnum silently aliases duplicates, so the bug is invisible at
+   runtime: the later name just *becomes* the earlier one and every
+   frame of that kind is parsed with the wrong schema);
+2. **append-only vs the golden ledger** (wire_golden.py) — every
+   recorded kind keeps its value and its packed itemsize; new kinds
+   must not reuse recorded values;
+3. **codec agreement** — the frame header format and
+   ``MAX_FRAME_ROWS`` in wire/codec.py match the ledger, and every
+   non-handshake kind has a schema (a kind without one is
+   undecodable: the stream latches corrupt at the first frame).
+
+The itemsize check *evaluates* wire/messages.py (numpy only, loaded by
+file path so no package ``__init__`` — and therefore no jax — is
+imported); everything else is AST.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+import types
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "wire-contract"
+
+MESSAGES_PATH = "minpaxos_tpu/wire/messages.py"
+CODEC_PATH = "minpaxos_tpu/wire/codec.py"
+
+# pseudo-kinds exchanged as single raw bytes before framed streaming
+# starts — never valid as frames, so no schema required
+_PSEUDO_PREFIX = "HANDSHAKE_"
+
+
+def _enum_assignments(tree: ast.Module,
+                      class_name: str) -> list[tuple[str, int, int]]:
+    """(name, value, line) for int-constant assignments in a class."""
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, int)):
+                    out.append((stmt.targets[0].id, stmt.value.value,
+                                stmt.lineno))
+    return out
+
+
+def _eval_messages(src: str, path: str):
+    """Execute messages.py standalone (enum + numpy only) and return
+    the module, or None on failure."""
+    mod = types.ModuleType("_paxlint_wire_messages")
+    mod.__file__ = path
+    try:
+        exec(compile(src, path, "exec"), mod.__dict__)
+    # paxlint: disable=broad-except -- deliberately broad: fixture or
+    # drifted sources under test may raise anything; the itemsize
+    # checks just degrade to AST-only
+    except Exception:
+        return None
+    return mod
+
+
+def _codec_constants(tree: ast.Module) -> dict[str, object]:
+    """Module-level constants the contract cares about."""
+    out: dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name == "MAX_FRAME_ROWS":
+                try:
+                    out[name] = ast.literal_eval(node.value)
+                except ValueError:
+                    # e.g. `1 << 22` — literal_eval can't; fold shifts
+                    v = node.value
+                    if (isinstance(v, ast.BinOp)
+                            and isinstance(v.op, ast.LShift)
+                            and isinstance(v.left, ast.Constant)
+                            and isinstance(v.right, ast.Constant)):
+                        out[name] = v.left.value << v.right.value
+            elif name == "_HEADER":
+                # _HEADER = struct.Struct("<BI")
+                v = node.value
+                if (isinstance(v, ast.Call) and v.args
+                        and isinstance(v.args[0], ast.Constant)):
+                    out[name] = v.args[0].value
+    return out
+
+
+def check(messages_src: str, codec_src: str | None,
+          golden_kinds: dict[str, tuple[int, int | None]],
+          golden_header_fmt: str, golden_max_rows: int,
+          messages_path: str = MESSAGES_PATH,
+          codec_path: str = CODEC_PATH) -> list[Violation]:
+    """The whole contract check, parameterized so tests can seed
+    drifted sources or alternative ledgers."""
+    out: list[Violation] = []
+    try:
+        tree = ast.parse(messages_src, filename=messages_path)
+    except SyntaxError:
+        return out  # the parse violation is reported centrally
+
+    assigns = _enum_assignments(tree, "MsgKind")
+    if not assigns:
+        out.append(Violation(messages_path, 1, RULE,
+                             "MsgKind registry not found"))
+        return out
+    by_name = {n: (v, line) for n, v, line in assigns}
+
+    # 1. collision-free (IntEnum would silently alias the duplicate)
+    seen: dict[int, str] = {}
+    for name, value, line in assigns:
+        if value in seen:
+            out.append(Violation(
+                messages_path, line, RULE,
+                f"opcode collision: {name} = {value} aliases "
+                f"{seen[value]} — IntEnum silently merges them and "
+                "every frame of one kind parses with the other's "
+                "schema"))
+        else:
+            seen[value] = name
+
+    # 2. append-only vs the golden ledger
+    mod = _eval_messages(messages_src, messages_path)
+    itemsizes: dict[str, int] = {}
+    if mod is not None and hasattr(mod, "SCHEMAS"):
+        for kind, dt in mod.SCHEMAS.items():
+            itemsizes[kind.name] = dt.itemsize
+    golden_values = {v for v, _ in golden_kinds.values()}
+    for name, (gvalue, gsize) in golden_kinds.items():
+        if name not in by_name:
+            out.append(Violation(
+                messages_path, 1, RULE,
+                f"recorded wire kind {name} (opcode {gvalue}) was "
+                "removed — the registry is append-only; deployed "
+                "peers still send it"))
+            continue
+        value, line = by_name[name]
+        if value != gvalue:
+            out.append(Violation(
+                messages_path, line, RULE,
+                f"opcode renumbered: {name} is {value}, ledger says "
+                f"{gvalue} — cross-version frames reinterpret bytes"))
+        size = itemsizes.get(name)
+        if gsize is not None and size is not None and size != gsize:
+            out.append(Violation(
+                messages_path, line, RULE,
+                f"packed width drift: {name} rows are {size} bytes, "
+                f"ledger says {gsize} — old peers will misframe the "
+                "stream"))
+    for name, (value, line) in by_name.items():
+        if name in golden_kinds:
+            continue
+        if value in golden_values:
+            out.append(Violation(
+                messages_path, line, RULE,
+                f"new kind {name} reuses recorded opcode {value} — "
+                "append with a fresh value"))
+        else:
+            # unrecorded kinds get no drift protection at all — the
+            # ledger must grow in the same PR that adds the kind
+            out.append(Violation(
+                messages_path, line, RULE,
+                f"new kind {name} (opcode {value}) is not recorded in "
+                "the wire ledger — run `tools/lint.py "
+                "--print-wire-golden` and extend "
+                "analysis/wire_golden.py in this PR"))
+
+    # every non-handshake kind must be decodable
+    if mod is not None and itemsizes:
+        for name, (value, line) in by_name.items():
+            if (not name.startswith(_PSEUDO_PREFIX)
+                    and name not in itemsizes):
+                out.append(Violation(
+                    messages_path, line, RULE,
+                    f"{name} has no SCHEMAS entry — frames of kind "
+                    f"{value} latch the stream corrupt at the decoder"))
+
+    # 3. codec agreement
+    if codec_src is not None:
+        try:
+            ctree = ast.parse(codec_src, filename=codec_path)
+        except SyntaxError:
+            return out
+        consts = _codec_constants(ctree)
+        fmt = consts.get("_HEADER")
+        if fmt is not None and fmt != golden_header_fmt:
+            out.append(Violation(
+                codec_path, 1, RULE,
+                f"frame header format {fmt!r} != recorded "
+                f"{golden_header_fmt!r} — peers cannot find frame "
+                "boundaries"))
+        if isinstance(fmt, str):
+            try:
+                struct.calcsize(fmt)
+            except struct.error:
+                out.append(Violation(
+                    codec_path, 1, RULE,
+                    f"frame header format {fmt!r} is not a valid "
+                    "struct format"))
+        rows = consts.get("MAX_FRAME_ROWS")
+        if rows is not None and rows != golden_max_rows:
+            out.append(Violation(
+                codec_path, 1, RULE,
+                f"MAX_FRAME_ROWS {rows} != recorded {golden_max_rows} "
+                "— one side rejects frames the other emits"))
+    return out
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    from minpaxos_tpu.analysis.wire_golden import (
+        GOLDEN_HEADER_FMT,
+        GOLDEN_KINDS,
+        GOLDEN_MAX_FRAME_ROWS,
+    )
+
+    msgs = project.get(MESSAGES_PATH)
+    if msgs is None:
+        return []  # fixture projects without a wire layer
+    codec = project.get(CODEC_PATH)
+    return check(msgs.src, codec.src if codec else None, GOLDEN_KINDS,
+                 GOLDEN_HEADER_FMT, GOLDEN_MAX_FRAME_ROWS)

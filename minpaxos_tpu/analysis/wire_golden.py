@@ -1,0 +1,59 @@
+"""The frozen wire contract: opcode values and packed row widths.
+
+This file is the append-only ledger the wire-contract pass checks
+``wire/messages.py`` and ``wire/codec.py`` against. The reference
+codebase assigns RPC codes in registration order at runtime
+(genericsmr.go:492-497) — an implicit contract SURVEY.md flags as
+fragile; this repo fixed the codes statically, and this snapshot makes
+that promise *enforced*: a replica built from one commit and a client
+built from another must never disagree about what opcode 18 means or
+how wide an ACCEPT row is, because frames are raw memcpy'd structs
+(wire/codec.py) with no per-field tags to catch a skew.
+
+Rules (see ANALYSIS.md):
+
+* every name below must still exist with the same opcode value and the
+  same packed itemsize — renaming, renumbering, or resizing is a
+  violation;
+* NEW kinds may be appended freely (with values not reusing any value
+  below) — after which they are added here, extending the ledger;
+* the frame header format and the corrupt-stream row bound are part of
+  the contract too: both ends must agree on them to even find frame
+  boundaries.
+
+To legitimately extend the contract, regenerate this table:
+``python tools/lint.py --print-wire-golden`` emits the current tree's
+table; paste it here in the same PR that adds the message kind.
+"""
+
+from __future__ import annotations
+
+# MsgKind name -> (opcode value, packed row itemsize in bytes).
+# itemsize None = handshake pseudo-kind (single raw byte, no schema).
+GOLDEN_KINDS: dict[str, tuple[int, int | None]] = {
+    "PROPOSE": (1, 29),
+    "PROPOSE_REPLY": (2, 22),
+    "READ": (3, 12),
+    "READ_REPLY": (4, 12),
+    "PROPOSE_AND_READ": (5, 21),
+    "PROPOSE_AND_READ_REPLY": (6, 13),
+    "BEACON": (7, 9),
+    "BEACON_REPLY": (8, 9),
+    "PREPARE": (16, 9),
+    "PREPARE_REPLY": (17, 14),
+    "ACCEPT": (18, 38),
+    "ACCEPT_REPLY": (19, 18),
+    "COMMIT": (20, 38),
+    "COMMIT_SHORT": (21, 13),
+    "PREPARE_INST": (24, 10),
+    "PREPARE_INST_REPLY": (25, 39),
+    "SKIP": (28, 9),
+    "HANDSHAKE_CLIENT": (120, None),
+    "HANDSHAKE_PEER": (121, None),
+}
+
+# frame header: [opcode u8][nrows u32], little-endian (wire/codec.py)
+GOLDEN_HEADER_FMT = "<BI"
+
+# corrupt-stream bound: both ends must reject the same frames
+GOLDEN_MAX_FRAME_ROWS = 1 << 22

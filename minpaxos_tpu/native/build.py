@@ -60,7 +60,11 @@ def try_build() -> None:
     cover all of it)."""
     try:
         build(quiet=True)
-    except Exception:  # noqa: BLE001 — opportunistic by design
+    # paxlint: disable=broad-except -- opportunistic by design: no
+    # toolchain / broken compiler / read-only checkout all fall back
+    # to the pure-Python paths, and a raise here would kill a server
+    # boot over a missing g++
+    except Exception:  # noqa: BLE001
         pass
 
 

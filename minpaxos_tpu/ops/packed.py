@@ -18,6 +18,8 @@ import jax.numpy as jnp
 def split_i64(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Host-side: int64 array -> (hi i32, lo i32) with lo holding the
     low 32 bits reinterpreted as signed."""
+    # paxlint: disable=trace-hazard -- host-side by contract: runs at
+    # the wire boundary on numpy frames, never under jit
     x = np.asarray(x, dtype=np.int64)
     hi = (x >> 32).astype(np.int32)
     lo = (x & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
@@ -26,7 +28,10 @@ def split_i64(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 def join_i64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
     """Host-side inverse of split_i64."""
+    # paxlint: disable=trace-hazard -- host-side by contract (see
+    # split_i64); int64 math must happen off-device (TPUs are 32-bit)
     hi = np.asarray(hi, dtype=np.int64)
+    # paxlint: disable=trace-hazard -- host-side by contract
     lo = np.asarray(lo).astype(np.int32).view(np.uint32).astype(np.int64)
     return (hi << 32) | lo
 

@@ -26,6 +26,8 @@ import sys
 import tempfile
 import time
 
+from minpaxos_tpu.utils.dlog import dlog
+
 
 def probe_backend(timeout_s: float = 120.0,
                   env: dict[str, str] | None = None) -> str | None:
@@ -108,8 +110,11 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.5)
-    except Exception:  # pragma: no cover - cache is best-effort
-        pass
+    except (OSError, ValueError, AttributeError,
+            RuntimeError) as e:  # pragma: no cover - cache is best-effort
+        # unwritable dir, or a jax version without these config knobs;
+        # boot must proceed (it just pays the cold compile)
+        dlog(f"compile cache unavailable: {e!r}")
 
 
 def init_backend(retries: int = 2, timeout_s: float = 120.0,
@@ -135,8 +140,11 @@ def init_backend(retries: int = 2, timeout_s: float = 120.0,
     if want:
         try:
             jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+        except (RuntimeError, ValueError, AttributeError) as e:
+            # RuntimeError: backend already initialized (re-asserting
+            # after first use is a no-op by design); ValueError /
+            # AttributeError: jax version without the knob
+            dlog(f"jax_platforms re-assert skipped: {e!r}")
         return jax.devices()
 
     if os.environ.get("MP_BENCH_PROBED"):
@@ -158,7 +166,12 @@ def init_backend(retries: int = 2, timeout_s: float = 120.0,
             progress("default backend unavailable; pinning cpu")
         try:
             jax.config.update("jax_platforms", "cpu")
-        except Exception as e:
+        except Exception as e:  # deliberately broad: ANY pin failure
+            # must take the documented fail-stop path (on_fail + clean
+            # SystemExit) — a raw traceback here would lose the bench
+            # harness's failure record. Re-raising keeps this exempt
+            # from the broad-except lint.
+            dlog(f"cpu pin failed: {e!r}")
             if on_fail is not None:
                 on_fail("backend-init", repr(e))
             raise SystemExit(0)

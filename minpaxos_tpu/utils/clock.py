@@ -16,7 +16,7 @@ import time
 
 try:  # pragma: no cover - exercised only when the native lib is built
     from minpaxos_tpu.native import libnative as _libnative
-except Exception:  # pragma: no cover
+except (ImportError, OSError):  # pragma: no cover - ctypes load failure
     _libnative = None
 
 

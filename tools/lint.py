@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""paxlint CLI — run the repo's consensus-aware static analysis.
+
+    python tools/lint.py                 # lint the whole tree, human output
+    python tools/lint.py --json          # machine output (bench tracking)
+    python tools/lint.py --rules wire-contract,concurrency
+    python tools/lint.py --list-rules
+    python tools/lint.py --print-wire-golden   # regen the wire ledger
+
+Exit status: 0 = clean, 1 = violations, 2 = usage error.
+
+Fast by design: pure AST + a numpy-only evaluation of the wire
+schemas; no jax import, so it runs cold in under a couple of seconds
+and belongs at the top of tools/run_tier1.sh. See ANALYSIS.md for the
+rule catalogue and the suppression syntax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+from minpaxos_tpu.analysis import PASSES, Project, run_passes  # noqa: E402
+
+
+def _print_wire_golden() -> None:
+    """Emit the current tree's wire ledger (paste into
+    analysis/wire_golden.py when legitimately extending the contract)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_wire_messages", REPO_ROOT / "minpaxos_tpu/wire/messages.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    print("GOLDEN_KINDS: dict[str, tuple[int, int | None]] = {")
+    for k in mod.MsgKind:
+        dt = mod.SCHEMAS.get(k)
+        size = dt.itemsize if dt is not None else None
+        print(f'    "{k.name}": ({int(k)}, {size}),')
+    print("}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "paxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--json", action="store_true",
+                   help="JSON output: violations + per-rule counts")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--root", default=str(REPO_ROOT),
+                   help="repo root to lint (default: this repo)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--print-wire-golden", action="store_true",
+                   help="emit the current wire ledger and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(PASSES):
+            doc = (PASSES[rule].__module__ or "").rsplit(".", 1)[-1]
+            print(f"{rule:20s} minpaxos_tpu/analysis/{doc}.py")
+        return 0
+    if args.print_wire_golden:
+        _print_wire_golden()
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+        unknown = [r for r in rules if r not in PASSES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}; known: "
+                  f"{', '.join(sorted(PASSES))}", file=sys.stderr)
+            return 2
+
+    project = Project.from_root(args.root)
+    violations = run_passes(project, rules)
+
+    if args.json:
+        print(json.dumps({
+            "clean": not violations,
+            "files_scanned": len(project.files),
+            "rules_run": sorted(rules if rules is not None else PASSES),
+            "counts": dict(Counter(v.rule for v in violations)),
+            "violations": [v.as_json() for v in violations],
+        }, indent=2))
+    else:
+        for v in violations:
+            print(v.render())
+        n = len(violations)
+        print(f"paxlint: {len(project.files)} files, "
+              f"{n} violation{'s' if n != 1 else ''}"
+              + ("" if n else " — clean"))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,13 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# paxlint first: pure-AST consensus-aware lint (ANALYSIS.md), no JAX
+# import, runs cold in ~2 s. A hot-path host sync, a wire-contract
+# drift, or a lock-discipline break fails the build before any test
+# boots a cluster.
+echo "== paxlint =="
+python tools/lint.py || exit 1
+
 if [ "${1:-}" = "smoke" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         -k "runtime_units or wire or fused" \
