@@ -335,6 +335,21 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         prof_cm = (jax.profiler.trace(prof_dir) if prof_dir
                    else contextlib.nullcontext())
 
+        # paxmon registry for the bench itself (obs/metrics.py): the
+        # artifact carries a typed end-of-run snapshot — dispatch
+        # walls as a histogram next to the medians, so a skewed run
+        # (one 30 s straggler dispatch) is visible in the record
+        from minpaxos_tpu.obs.metrics import MetricsRegistry
+
+        mx = MetricsRegistry(namespace="bench")
+        mx_disp = mx.counter("dispatches")
+        mx_rounds = mx.counter("rounds")
+        mx_committed = mx.gauge("committed_healthy")
+        mx_wall = mx.histogram(
+            "dispatch_wall_ms",
+            bounds=(50.0, 100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0,
+                    15000.0, 60000.0))
+
         # -- measured phase 1: healthy, healthy_d fused dispatches --
         start_committed, _, _ = sc.committed()
         u0, c0 = shard_cursors(cfg, sc.leader, sc.ss)
@@ -347,11 +362,15 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
                 U.append(u)
                 C.append(c)
                 walls.append(time.perf_counter())
+                mx_disp.inc()
+                mx_rounds.inc(k)
+                mx_wall.observe((walls[-1] - walls[-2]) * 1e3)
                 _progress(f"healthy dispatch {i}: "
                           f"{(walls[-1] - walls[-2]) * 1e3:.0f}ms / {k} rounds")
         healthy_wall = walls[-1] - walls[0]
         healthy_rounds = healthy_d * k
         committed_healthy = int((U[-1][-1] + 1).sum()) - start_committed
+        mx_committed.set(committed_healthy)
         throughput = committed_healthy / healthy_wall
         round_ms = healthy_wall / healthy_rounds * 1e3
 
@@ -491,6 +510,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
             "substeps": SS_N,
             "proposals_per_round": g * p,
             "committed_total": committed_total,
+            "metrics": mx.snapshot(),
             "kill_recover": kill_recover,
             "n_replicas": cfg.n_replicas,
             "n_shards": g,

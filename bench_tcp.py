@@ -79,6 +79,10 @@ RUNTIME_KNOBS = {
     "fuse_ticks": os.environ.get("BENCH_TCP_FUSE", "3"),
     "idle_fastpath": os.environ.get("BENCH_TCP_IDLEFAST", "1") != "0",
     "narrow_window": os.environ.get("BENCH_TCP_NARROW", "0"),
+    # paxmon flight recorder (default ON, the production shape);
+    # BENCH_TCP_RECORDER=0 runs -norecorder for the overhead A/B
+    # (acceptance: p50 + closed-loop within 3% of disabled)
+    "recorder": os.environ.get("BENCH_TCP_RECORDER", "1") != "0",
 }
 
 
@@ -88,11 +92,26 @@ def _knob_args(keyhint: int) -> list:
             "-keyhint", str(keyhint)]
     if not RUNTIME_KNOBS["idle_fastpath"]:
         args.append("-noidlefast")
+    if not RUNTIME_KNOBS["recorder"]:
+        args.append("-norecorder")
     return args
 
 
 def _progress(msg: str) -> None:
     print(f"[bench_tcp] {msg}", file=sys.stderr, flush=True)
+
+
+def _metrics_snapshot(maddr) -> dict:
+    """End-of-run paxmon snapshot through the master's stats fan-out:
+    dispatch-regime mix, tick-latency histograms and per-replica
+    counters ride the artifact, so a number can be decomposed after
+    the fact (OBSERVABILITY.md) without rerunning the bench."""
+    try:
+        from minpaxos_tpu.runtime.master import cluster_stats
+
+        return cluster_stats(maddr)
+    except Exception as e:  # noqa: BLE001 — obs must not fail a bench
+        return {"error": repr(e)[:200]}
 
 
 def _boot(proto_flag: str, env, tmp, shape) -> tuple[list, int]:
@@ -237,6 +256,8 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
             _progress(f"{label}: trial {t}: {rates[-1]} ops/s"
                       f" ({trial_stats[-1]})")
 
+        metrics_snap = _metrics_snapshot(maddr)
+
         # the headline median is over CLEAN trials only; if none
         # survived, the record keeps the all-trial median but its
         # "check" field carries every failure, so it cannot read as
@@ -253,6 +274,7 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
             "server_shape": " ".join(shape),
             "runtime_knobs": dict(RUNTIME_KNOBS),
             "reference_shape": ref_shape,
+            "metrics_snapshot": metrics_snap,
         }
 
 
@@ -278,6 +300,7 @@ def run_serial(proto_flag: str, label: str) -> dict:
                                     np.asarray([i]), timeout_s=10.0):
                 lats.append((time.perf_counter() - t1) * 1e3)
         cli.close_conn()
+        metrics_snap = _metrics_snapshot(maddr)
         lats.sort()
         return {
             "serial_p50_ms": round(lats[len(lats) // 2], 3)
@@ -287,6 +310,7 @@ def run_serial(proto_flag: str, label: str) -> dict:
             "n_serial": len(lats),
             "serial_shape": " ".join(SERIAL_SHAPE),
             "runtime_knobs": dict(RUNTIME_KNOBS),
+            "serial_metrics_snapshot": metrics_snap,
         }
 
 

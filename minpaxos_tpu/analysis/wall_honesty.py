@@ -14,11 +14,24 @@ counter 3x faster than wall time, so the retry/takeover thresholds
 in cli/server.py) fire k times early; under load that is a
 ballot-bump/re-drive storm, the exact collapse the round-5 bench hit.
 
-Mechanically: in models/*.py, any ``+``/``-`` expression over an
-attribute whose name says it counts ticks/stalls/retries must mention
-``tick_inc`` somewhere in that expression. Config-carried thresholds
-(``cfg.noop_delay``, ``cfg.gossip_ticks``) are not counters and are
-exempt.
+Two checks:
+
+* **kernel counters** (models/): any ``+``/``-`` expression over an
+  attribute whose name says it counts ticks/stalls/retries must
+  mention ``tick_inc`` somewhere in that expression. Config-carried
+  thresholds (``cfg.noop_delay``, ``cfg.gossip_ticks``) are not
+  counters and are exempt.
+* **registry/recorder counters** (models/ AND runtime/): a paxmon
+  counter advance (``<handle>.inc(...)`` where the handle chain or
+  metric-name string is counter-ish — ``inc`` is the only advance
+  method obs/metrics.py defines; ``.add`` would only match builtin
+  sets) must carry ``tick_inc`` in its arguments. The host-side failure
+  mode is the same one, relocated: the tick loop runs once per
+  dispatch, so ``ticks.inc(k)`` would count fused device substeps as
+  wall ticks and every consumer of the tick rate (paxtop throughput,
+  idle-skip ratios, the recorder-overhead guard) would read k-times
+  wall. Event counters (``idle_skips``, ``dispatches``,
+  ``fused_substeps``) are not tick-named and advance freely.
 """
 
 from __future__ import annotations
@@ -31,6 +44,9 @@ from minpaxos_tpu.analysis.core import Project, Violation, register
 RULE = "wall-honesty"
 
 SCOPE_PREFIX = "minpaxos_tpu/models/"
+#: scope of the registry-advance check: kernels AND the host runtime
+#: that owns the paxmon registry (runtime/replica.py)
+REG_SCOPE_PREFIXES = ("minpaxos_tpu/models/", "minpaxos_tpu/runtime/")
 
 # counter-ish attribute names: 'tick', 'stall_ticks', 'retry_count', ...
 _COUNTER_RE = re.compile(
@@ -39,6 +55,12 @@ _COUNTER_RE = re.compile(
 _EXEMPT_ATTRS = frozenset({"tick_inc", "gossip_ticks", "noop_delay",
                            "fuse_ticks", "tick_s"})
 _EXEMPT_BASES = frozenset({"cfg", "config", "flags", "self"})
+
+#: paxmon counter-advance method names: Counter.inc is the ONLY
+#: advance obs/metrics.py defines (Gauge.set is an absolute write, and
+#: including "add" would flag builtin-set mutations like
+#: `self.retry_conns.add(x)` as counter advances)
+_ADVANCE_METHODS = frozenset({"inc"})
 
 
 def _counter_attr(node: ast.expr) -> str | None:
@@ -58,25 +80,62 @@ def _mentions_tick_inc(node: ast.expr) -> bool:
                for n in ast.walk(node))
 
 
+def _registry_counter_token(call: ast.Call) -> str | None:
+    """The counter-ish name a ``.inc(...)``/``.add(...)`` call advances
+    — from the receiver chain's attribute/variable names or a metric
+    name string (``reg.counter("stall_ticks").inc(...)``) — else None.
+    """
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr in _ADVANCE_METHODS):
+        return None
+    for n in ast.walk(f.value):
+        name = None
+        if isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            name = n.value
+        if (name and name not in _EXEMPT_ATTRS
+                and _COUNTER_RE.search(name)):
+            return name
+    return None
+
+
 @register(RULE)
 def run(project: Project) -> list[Violation]:
     out: list[Violation] = []
     for f in project.files.values():
-        if f.tree is None or not f.path.startswith(SCOPE_PREFIX):
+        if f.tree is None:
+            continue
+        in_models = f.path.startswith(SCOPE_PREFIX)
+        in_reg_scope = f.path.startswith(REG_SCOPE_PREFIXES)
+        if not (in_models or in_reg_scope):
             continue
         for node in ast.walk(f.tree):
-            if not (isinstance(node, ast.BinOp)
+            if (in_models and isinstance(node, ast.BinOp)
                     and isinstance(node.op, (ast.Add, ast.Sub))):
-                continue
-            attr = _counter_attr(node.left) or _counter_attr(node.right)
-            if attr is None:
-                continue
-            if _mentions_tick_inc(node):
-                continue
-            out.append(Violation(
-                f.path, node.lineno, RULE,
-                f"counter `{attr}` updated without `tick_inc` — under "
-                "fused substeps (ops/substeps.py) it ages k times "
-                "faster than wall time, firing stall/retry/takeover "
-                "thresholds early"))
+                attr = _counter_attr(node.left) or _counter_attr(node.right)
+                if attr is None or _mentions_tick_inc(node):
+                    continue
+                out.append(Violation(
+                    f.path, node.lineno, RULE,
+                    f"counter `{attr}` updated without `tick_inc` — "
+                    "under fused substeps (ops/substeps.py) it ages k "
+                    "times faster than wall time, firing stall/retry/"
+                    "takeover thresholds early"))
+            elif in_reg_scope and isinstance(node, ast.Call):
+                tok = _registry_counter_token(node)
+                if tok is None:
+                    continue
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if any(_mentions_tick_inc(a) for a in args):
+                    continue
+                out.append(Violation(
+                    f.path, node.lineno, RULE,
+                    f"registry counter `{tok}` advanced without "
+                    "`tick_inc` — a wall-tick metric fed device "
+                    "substeps (or a literal) counts k times wall time "
+                    "under fusion; advance tick-named paxmon counters "
+                    "by a `tick_inc` expression (obs/metrics.py)"))
     return out

@@ -100,6 +100,14 @@ def main(argv=None) -> None:
                    help="expected distinct keys in the workload; the"
                         " server logs projected KV load vs -kvpow2"
                         " capacity at startup (saturation fail-stops)")
+    p.add_argument("-norecorder", action="store_true",
+                   help="disable the paxmon flight recorder (the"
+                        " per-tick ring served by the control socket's"
+                        " TRACE verb; see OBSERVABILITY.md) — for"
+                        " overhead A/Bs; the metrics registry stays on")
+    p.add_argument("-recring", type=int, default=4096,
+                   help="flight-recorder ring capacity in ticks"
+                        " (12 int64 fields per row: 4096 ≈ 384 KiB)")
     p.add_argument("-storedir", default=".",
                    help="stable store directory")
     p.add_argument("-platform", default="cpu",
@@ -131,6 +139,11 @@ def main(argv=None) -> None:
     maddr = (args.maddr, args.mport)
     my_id = register_with_master(maddr, args.addr, args.port)
     nodes = get_replica_list(maddr)
+    # every dlog line from this process now carries its replica id —
+    # N servers interleaving one terminal's stderr stay attributable
+    from minpaxos_tpu.utils.dlog import set_dlog_id
+
+    set_dlog_id(f"r{my_id}")
     print(f"server: registered as replica {my_id} of {len(nodes)}",
           flush=True)
 
@@ -161,6 +174,8 @@ def main(argv=None) -> None:
                          narrow_window=args.narrow,
                          key_hint=args.keyhint,
                          warm_variants=True,
+                         recorder=not args.norecorder,
+                         recorder_ring=args.recring,
                          profile=prof)
     server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags,
                            protocol=protocol)

@@ -1,0 +1,53 @@
+"""paxmon — observability for the TPU consensus runtime.
+
+The reference repo's only runtime evidence is scattered ``log.Printf``
+calls; this package is the layer the ROADMAP's production north star
+presupposes: a **typed metrics registry** (counters / gauges /
+fixed-bucket histograms, thread-safe snapshots, zero allocation on the
+protocol thread's hot path) and a **per-tick flight recorder** (a
+fixed-size numpy ring logging dispatch kind, fused k, row counts,
+frontier, exec backlog and the per-phase wall decomposition —
+drain / device step / persist / dispatch / reply), exportable as
+Chrome trace-event JSON loadable in Perfetto.
+
+Deliberately dependency-light (stdlib + numpy, no jax): the control
+plane, ``tools/paxtop.py`` and the CI smoke (``tools/obs_smoke.py``)
+must all run cold without a backend init.
+
+Consumers:
+
+* ``runtime/replica.py`` — owns one registry + recorder per replica,
+  serves them over the control socket (``STATS`` / ``TRACE`` verbs).
+* ``runtime/master.py`` — fans the verbs out cluster-wide.
+* ``tools/paxtop.py`` — the live terminal view.
+* ``bench.py`` / ``bench_tcp.py`` — embed end-of-run snapshots in
+  their artifacts.
+
+See OBSERVABILITY.md at the repo root for the metric catalogue and
+the trace field glossary.
+"""
+
+from minpaxos_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    TICK_MS_BUCKETS,
+)
+from minpaxos_tpu.obs.recorder import (
+    FlightRecorder,
+    KIND_FULL,
+    KIND_FUSED,
+    KIND_IDLE_SKIP,
+    KIND_NAMES,
+    KIND_NARROW,
+    chrome_trace,
+    validate_chrome_trace,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "TICK_MS_BUCKETS", "FlightRecorder", "KIND_FULL", "KIND_FUSED",
+    "KIND_NARROW", "KIND_IDLE_SKIP", "KIND_NAMES", "chrome_trace",
+    "validate_chrome_trace",
+]

@@ -1,0 +1,175 @@
+"""Per-tick flight recorder: a fixed-size numpy ring of dispatch rows.
+
+Every protocol-thread wakeup appends ONE row (a single slice-assign
+into a preallocated int64 matrix — no allocation, no growth): when it
+happened, which dispatch regime ran (full / fused / narrow /
+idle-skip — PR 1's multi-modal tick cost), how many substeps fused,
+rows in/out, the commit frontier, the exec backlog, and the per-phase
+wall decomposition (drain / device step / persist / dispatch / reply)
+in microseconds. The ring holds the last ``capacity`` ticks; the
+control plane's TRACE verb exports it as Chrome trace-event JSON that
+loads directly in Perfetto (``ui.perfetto.dev``) or
+``chrome://tracing`` — per-phase latency decomposition is exactly
+what the "Paxos in the Cloud" experience report says deployments live
+or die by, and what PERF.md's round-6 misfire hunt had to reconstruct
+by hand from stderr.
+
+Timestamps are ``monotonic_ns`` (CLOCK_MONOTONIC is machine-wide on
+Linux), so traces merged across the replica processes of one host
+share a timeline.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+# dispatch regimes (runtime/replica.py classifies one per tick:
+# narrow > fused > full; idle-skip never reaches the device)
+KIND_FULL, KIND_FUSED, KIND_NARROW, KIND_IDLE_SKIP = 0, 1, 2, 3
+KIND_NAMES = ("full", "fused", "narrow", "idle_skip")
+
+# ring-row field layout (glossary in OBSERVABILITY.md)
+(F_T_NS, F_KIND, F_K, F_ROWS_IN, F_ROWS_OUT, F_FRONTIER, F_BACKLOG,
+ F_DRAIN_US, F_STEP_US, F_PERSIST_US, F_DISPATCH_US, F_REPLY_US) = range(12)
+N_FIELDS = 12
+FIELD_NAMES = ("t_ns", "kind", "k", "rows_in", "rows_out", "frontier",
+               "exec_backlog", "drain_us", "step_us", "persist_us",
+               "dispatch_us", "reply_us")
+
+_PHASES = (("drain", F_DRAIN_US), ("device_step", F_STEP_US),
+           ("persist", F_PERSIST_US), ("dispatch", F_DISPATCH_US),
+           ("reply", F_REPLY_US))
+
+_EVENT_PHASES = frozenset("XBEiICMsnbe")  # trace-event ph codes we accept
+
+
+class FlightRecorder:
+    """Fixed-capacity ring buffer of per-tick rows.
+
+    ``record`` is called by the protocol thread only; ``snapshot`` /
+    ``to_events`` may be called from any thread (control plane) — the
+    tiny lock only orders the one-row write against the copy, it is
+    never held across anything blocking.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"recorder capacity must be >= 1: {capacity}")
+        self.capacity = capacity
+        self._buf = np.zeros((capacity, N_FIELDS), np.int64)
+        self.total = 0  # rows ever recorded (ring holds the last cap)
+        self._lock = threading.Lock()
+
+    def record(self, t_ns: int, kind: int, k: int, rows_in: int,
+               rows_out: int, frontier: int, backlog: int, drain_us: int,
+               step_us: int, persist_us: int, dispatch_us: int,
+               reply_us: int) -> None:
+        with self._lock:
+            self._buf[self.total % self.capacity] = (
+                t_ns, kind, k, rows_in, rows_out, frontier, backlog,
+                drain_us, step_us, persist_us, dispatch_us, reply_us)
+            self.total += 1
+
+    def snapshot(self, last: int | None = None) -> np.ndarray:
+        """Recorded rows oldest-first (a copy; [n, N_FIELDS] int64),
+        wraparound resolved. ``last`` keeps only the newest N rows."""
+        with self._lock:
+            n = min(self.total, self.capacity)
+            if self.total <= self.capacity:
+                out = self._buf[:n].copy()
+            else:
+                i = self.total % self.capacity
+                out = np.concatenate([self._buf[i:], self._buf[:i]])
+        if last is not None and 0 <= last < len(out):
+            out = out[len(out) - last:]
+        return out
+
+    def to_events(self, pid: int = 0, last: int | None = None) -> list[dict]:
+        """Chrome trace events for the recorded rows: one enclosing
+        ``X`` (complete) event per tick carrying the row's args, child
+        ``X`` events for each non-zero phase laid end-to-end inside
+        it, and ``C`` (counter) events for frontier / exec backlog.
+        ``pid`` should be the replica id so merged cluster traces get
+        one track group per replica."""
+        events: list[dict] = []
+        for r in self.snapshot(last):
+            dur = sum(int(r[i]) for _, i in _PHASES)
+            t_end = int(r[F_T_NS]) / 1e3  # trace-event ts unit: us
+            t0 = t_end - dur
+            kind = KIND_NAMES[int(r[F_KIND])]
+            events.append({
+                "name": f"tick:{kind}", "cat": "tick", "ph": "X",
+                "ts": t0, "dur": max(dur, 1), "pid": pid, "tid": 0,
+                "args": {"kind": kind, "k": int(r[F_K]),
+                         "rows_in": int(r[F_ROWS_IN]),
+                         "rows_out": int(r[F_ROWS_OUT]),
+                         "frontier": int(r[F_FRONTIER]),
+                         "exec_backlog": int(r[F_BACKLOG])}})
+            if int(r[F_KIND]) != KIND_IDLE_SKIP:
+                t = t0
+                for name, i in _PHASES:
+                    d = int(r[i])
+                    if d > 0:
+                        events.append({"name": name, "cat": "phase",
+                                       "ph": "X", "ts": t, "dur": d,
+                                       "pid": pid, "tid": 0})
+                    t += d
+            events.append({"name": "frontier", "ph": "C", "ts": t_end,
+                           "pid": pid, "tid": 0,
+                           "args": {"frontier": int(r[F_FRONTIER])}})
+            events.append({"name": "exec_backlog", "ph": "C", "ts": t_end,
+                           "pid": pid, "tid": 0,
+                           "args": {"exec_backlog": int(r[F_BACKLOG])}})
+        return events
+
+
+def chrome_trace(events: list[dict]) -> dict:
+    """Wrap an event list in the trace-event JSON object format."""
+    return {"traceEvents": list(events), "displayTimeUnit": "ms"}
+
+
+def validate_chrome_trace(trace) -> list[str]:
+    """Schema errors for a trace-event JSON object ([] = valid).
+
+    Checks the contract Perfetto/chrome://tracing actually rely on:
+    the JSON-object form with a ``traceEvents`` list, and per event a
+    string ``name``, a known ``ph`` code, numeric ``ts``, integer
+    ``pid``/``tid``, a numeric non-negative ``dur`` on complete (X)
+    events, and an ``args`` object of numbers on counter (C) events.
+    Used by the tests, ``tools/obs_smoke.py`` and paxtop's trace dump
+    so a malformed export fails loudly at the source, not in a viewer.
+    """
+    errs: list[str] = []
+    if not isinstance(trace, dict):
+        return [f"trace must be a JSON object, got {type(trace).__name__}"]
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["missing/non-list traceEvents"]
+    for i, ev in enumerate(evs):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errs.append(f"{where}: missing string name")
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or ph not in _EVENT_PHASES:
+            errs.append(f"{where}: bad ph {ph!r}")
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            errs.append(f"{where}: non-numeric ts")
+        for key in ("pid", "tid"):
+            if key in ev and not isinstance(ev[key], int):
+                errs.append(f"{where}: non-integer {key}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errs.append(f"{where}: X event needs numeric dur >= 0")
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args or not all(
+                    isinstance(v, (int, float)) for v in args.values()):
+                errs.append(f"{where}: C event needs numeric args")
+    return errs

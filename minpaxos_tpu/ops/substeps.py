@@ -47,6 +47,14 @@ from minpaxos_tpu.models.minpaxos import COMMITTED, MsgBatch
  SCAL_WORK_PENDING) = range(12)
 N_SCAL = 12
 
+# positional names for the vector above — the observability layer's
+# STATS verb surfaces the whole published vector by name (paxmon,
+# OBSERVABILITY.md) without any extra device read
+SCAL_NAMES = ("frontier", "window_base", "crt_inst", "kv_dropped",
+              "exec_lo", "exec_count", "leader", "prepared", "executed",
+              "low_anchor", "high_anchor", "work_pending")
+assert len(SCAL_NAMES) == N_SCAL
+
 _BIG = jnp.int32(2 ** 30)
 
 

@@ -23,6 +23,7 @@ import time
 
 import numpy as np
 
+from minpaxos_tpu.obs.metrics import MetricsRegistry
 from minpaxos_tpu.runtime.master import get_leader, get_replica_list
 from minpaxos_tpu.utils.dlog import dlog
 from minpaxos_tpu.wire.codec import FrameWriter, StreamDecoder
@@ -61,6 +62,16 @@ class Client:
         self.replies: dict[int, dict] = {}  # cmd_id -> reply
         self.dup_replies = 0
         self.rejected: list[int] = []
+        # paxmon client-side registry: retries and failovers are
+        # otherwise invisible in bench artifacts (a trial that quietly
+        # failed over twice is not the same measurement as a clean one)
+        self.metrics = MetricsRegistry(namespace="client")
+        self._c_proposed = self.metrics.counter(
+            "proposed_rows", "command rows written to the wire "
+            "(> workload size means retries happened)")
+        self._c_failovers = self.metrics.counter(
+            "failovers", "connection re-routes (leader hint / master "
+            "/ scan)")
         self.leader_hint = -1
         self._lock = threading.Lock()
         self._got = threading.Condition(self._lock)
@@ -162,6 +173,7 @@ class Client:
                            timestamp=time.monotonic_ns())
         self.writer.write(MsgKind.PROPOSE, frame)
         self.writer.flush()
+        self._c_proposed.inc(len(frame))
 
     def read(self, cmd_ids, keys) -> None:
         frame = make_batch(MsgKind.READ, cmd_id=np.asarray(cmd_ids, np.int32),
@@ -198,7 +210,8 @@ class Client:
         return {"sent": n, "acked": done, "wall_s": wall,
                 "ops_per_s": done / wall if wall > 0 else 0.0,
                 "duplicates": stats["duplicates"],
-                "missing": n - done}
+                "missing": n - done,
+                "client_metrics": self.metrics.counters()}
 
     def run_partition(self, idx: np.ndarray, ops, keys, vals,
                       batch: int = 512, timeout_s: float = 60.0) -> dict:
@@ -256,6 +269,7 @@ class Client:
         (clientretry.go:242-251)."""
         if self._done:
             return
+        self._c_failovers.inc()
         candidates: list[int] = []
         if 0 <= self.leader_hint < len(self.nodes):
             candidates.append(self.leader_hint)
@@ -403,9 +417,14 @@ class MultiClient:
             # mode's design, not duplicates)
             dups = sum(c.dup_replies for c in self.clients)
         wall = time.monotonic() - t0
+        cm: dict = {}
+        for c in self.clients:  # summed across the per-replica conns
+            for name, v in c.metrics.counters().items():
+                cm[name] = cm.get(name, 0) + v
         return {"sent": n, "acked": done, "wall_s": wall,
                 "ops_per_s": done / wall if wall > 0 else 0.0,
-                "duplicates": dups, "missing": n - done}
+                "duplicates": dups, "missing": n - done,
+                "client_metrics": cm}
 
     def close(self) -> None:
         for c in self.clients:

@@ -24,6 +24,7 @@ import socket
 import threading
 import time
 
+from minpaxos_tpu.obs.recorder import chrome_trace
 from minpaxos_tpu.utils.dlog import dlog
 from minpaxos_tpu.utils.netutil import CONTROL_OFFSET
 
@@ -103,6 +104,12 @@ class Master:
 
     def _handle(self, req: dict) -> dict:
         m = req.get("m")
+        if m in ("stats", "trace"):
+            # paxmon fan-out verbs: these poll every replica's control
+            # socket, so they must NOT run under the membership lock —
+            # one slow replica's 2 s control timeout would stall the
+            # ping loop and every registration behind it
+            return self._observe(m, req)
         with self._lock:
             if m == "register":
                 addr = (req["addr"], int(req["port"]))
@@ -130,6 +137,61 @@ class Master:
                 return {"ok": True, "leader": self.leader,
                         "addr": host, "port": port}
             return {"ok": False, "error": f"unknown method {m}"}
+
+    # -- paxmon: cluster-wide STATS / TRACE fan-out --
+
+    def _observe(self, m: str, req: dict) -> dict:
+        """Forward the replica-level ``stats``/``trace`` control verb
+        to every registered replica and merge the answers: paxtop and
+        the bench artifacts get the whole cluster in one RPC. A dead
+        replica contributes an error stanza, never a fan-out failure.
+        Membership is copied under the lock; the per-replica RPCs run
+        outside it (they block up to their timeout)."""
+        with self._lock:
+            nodes = list(enumerate(self.nodes))
+            leader = self.leader
+            alive = list(self.alive)
+        sub = {"m": m} if m == "stats" else \
+            {"m": "trace", "last": req.get("last")}
+        timeout = 5.0 if m == "trace" else 2.0
+        # one poller thread per replica: dead replicas cost
+        # max(timeout), not sum — a mostly-down cluster must still
+        # answer inside the caller's own socket timeout
+        slots: list[dict | None] = [None] * len(nodes)
+
+        def poll(i, rid, host, port):
+            try:
+                r = _rpc((host, port + CONTROL_OFFSET), sub,
+                         timeout=timeout)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                r = {"ok": False, "error": repr(e)[:120]}
+            r.setdefault("id", rid)
+            slots[i] = r  # last write: a non-None slot is fully built
+
+        pollers = [threading.Thread(target=poll,
+                                    args=(i, rid, host, port), daemon=True)
+                   for i, (rid, (host, port)) in enumerate(nodes)]
+        for t in pollers:
+            t.start()
+        for t in pollers:
+            t.join(timeout=timeout + 2.0)
+        replicas: list[dict] = []
+        events: list[dict] = []
+        for i, r in enumerate(slots):
+            if r is None:  # poller still hung past its own timeout
+                r = {"ok": False, "id": nodes[i][0],
+                     "error": "control rpc timed out"}
+            if m == "trace":
+                events.extend(r.pop("events", []))
+            replicas.append(r)
+        out = {"ok": True, "leader": leader, "alive": alive,
+               "n": self.n, "replicas": replicas}
+        if m == "trace":
+            # one merged Chrome trace object: each replica's events
+            # already carry pid=replica id, and monotonic timestamps
+            # share the host clock, so the merge is a concatenation
+            out["trace"] = chrome_trace(events)
+        return out
 
     # -- liveness + election (master.go:81-111) --
 
@@ -245,6 +307,20 @@ def get_replica_list(maddr: tuple[str, int],
             pass
         time.sleep(0.3)
     raise TimeoutError("replica list never completed")
+
+
+def cluster_stats(maddr: tuple[str, int], timeout_s: float = 15.0) -> dict:
+    """One-shot cluster metrics snapshot via the master's ``stats``
+    fan-out (paxtop's poll; bench artifacts embed the same shape)."""
+    return _rpc(maddr, {"m": "stats"}, timeout=timeout_s)
+
+
+def cluster_trace(maddr: tuple[str, int], last: int | None = None,
+                  timeout_s: float = 60.0) -> dict:
+    """Merged Chrome trace of every replica's flight recorder (newest
+    ``last`` ticks each). The returned ``["trace"]`` object loads
+    directly in Perfetto / chrome://tracing."""
+    return _rpc(maddr, {"m": "trace", "last": last}, timeout=timeout_s)
 
 
 def get_leader(maddr: tuple[str, int], timeout_s: float = 60.0) -> int:

@@ -33,25 +33,49 @@ CONN_LOST = 2
 
 
 class _Conn:
-    __slots__ = ("sock", "writer", "alive")
+    # frames_in/rows_in/bytes_in are owned by this connection's reader
+    # thread and frames_out by the protocol thread (the only writer) —
+    # single-writer tallies, aggregated lock-free-at-the-hot-path into
+    # the paxmon registry via fn-gauges at snapshot time
+    __slots__ = ("sock", "writer", "alive", "frames_in", "rows_in",
+                 "bytes_in", "frames_out")
 
     def __init__(self, sock):
         self.sock = sock
         self.writer = FrameWriter(sock)
         self.alive = True
+        self.frames_in = 0
+        self.rows_in = 0
+        self.bytes_in = 0
+        self.frames_out = 0
 
 
 class Transport:
     """Owns every socket of one replica process."""
 
     def __init__(self, me: int, addrs: list[tuple[str, int]],
-                 inbox_queue: "queue.Queue | None" = None):
+                 inbox_queue: "queue.Queue | None" = None, metrics=None):
         self.me = me
         self.addrs = addrs  # data-port address of every replica, by id
         self.n = len(addrs)
         self.queue: queue.Queue = inbox_queue or queue.Queue()
         self.peers: dict[int, _Conn] = {}
         self.clients: dict[int, _Conn] = {}
+        # tallies of connections that were REPLACED (peer redial): the
+        # fn-gauges below must stay monotonic — summing live conns
+        # only would regress the totals on every reconnect, turning
+        # delta-based rates negative. Guarded by _lock.
+        self._closed_tallies = {"frames_in": 0, "rows_in": 0,
+                                "bytes_in": 0, "frames_out": 0}
+        if metrics is not None:
+            # wire visibility in the owner's registry: evaluated at
+            # snapshot time (obs/metrics.py fn_gauge), so the per-frame
+            # hot path stays a plain attribute add on the _Conn
+            metrics.fn_gauge("peer_conns_alive", self._peers_alive)
+            metrics.fn_gauge("client_conns", lambda: len(self.clients))
+            for attr in ("frames_in", "rows_in", "bytes_in", "frames_out"):
+                metrics.fn_gauge(f"net_{attr}",
+                                 lambda a=attr: self._net_total(a))
         # Client connection ids are globally unique across replicas
         # (replica id in the high bits): command provenance travels
         # through the log as (client_id, cmd_id), and a follower
@@ -62,6 +86,20 @@ class Transport:
         self._listener: socket.socket | None = None
         self._stop = threading.Event()
         self._last_dial: dict[int, float] = {}
+
+    def _conns(self) -> list:
+        with self._lock:
+            return list(self.peers.values()) + list(self.clients.values())
+
+    def _peers_alive(self) -> int:
+        with self._lock:
+            return sum(c.alive for c in self.peers.values())
+
+    def _net_total(self, attr: str) -> int:
+        with self._lock:
+            total = self._closed_tallies[attr]
+            conns = list(self.peers.values()) + list(self.clients.values())
+        return total + sum(getattr(c, attr) for c in conns)
 
     # -- lifecycle --
 
@@ -168,6 +206,13 @@ class Transport:
     def _install_peer(self, q: int, sock) -> None:
         with self._lock:
             old = self.peers.get(q)
+            if old is not None:
+                # fold the replaced conn's tallies into the carry so
+                # the net_* gauges never go backward on redial (the
+                # old reader thread may race a final frame in — a
+                # bounded monitoring undercount, not a regression)
+                for attr in self._closed_tallies:
+                    self._closed_tallies[attr] += getattr(old, attr)
             self.peers[q] = conn = _Conn(sock)
         if old is not None:
             try:
@@ -192,7 +237,10 @@ class Transport:
                 frames = dec.feed(chunk)
             except ValueError:
                 break
+            conn.bytes_in += len(chunk)
+            conn.frames_in += len(frames)
             for kind, rows in frames:
+                conn.rows_in += len(rows)
                 self.queue.put((src_kind, conn_id, kind, rows))
             if dec.error is not None:
                 break
@@ -212,6 +260,7 @@ class Transport:
             return False
         try:
             conn.writer.write(kind, rows)
+            conn.frames_out += 1
             return True
         except OSError:
             conn.alive = False
@@ -223,6 +272,7 @@ class Transport:
             return False
         try:
             conn.writer.write(kind, rows)
+            conn.frames_out += 1
             return True
         except OSError:
             conn.alive = False

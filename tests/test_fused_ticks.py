@@ -365,7 +365,8 @@ def test_kv_sizing_startup_line_and_saturation_warning(tmp_path, capsys):
     assert "KV table capacity 64" in err
     assert "projected load 0.94" in err and "OVER" in err
     # saturation warning: force a near-full table + a check-due tick
-    srv.stats["dispatches"] = 1024
+    # (stats is a snapshot property now — set the live counter)
+    srv.metrics.counter("dispatches").value = 1024
     srv.state = srv.state._replace(
         kv=srv.state.kv._replace(slot=jnp.ones_like(srv.state.kv.slot)))
     srv._check_kv_load()

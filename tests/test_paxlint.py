@@ -355,6 +355,49 @@ def test_wall_honesty_scoped_to_models():
     assert lint_src("minpaxos_tpu/runtime/r.py", src, "wall-honesty") == []
 
 
+def test_wall_honesty_registry_advance_fires_in_runtime():
+    """The paxmon extension: a tick-named registry counter advanced by
+    a literal in runtime/ counts fused device substeps as wall ticks —
+    must carry tick_inc (obs/metrics.py wall-honesty contract)."""
+    src = '''
+class R:
+    def _tick(self, k):
+        self._c_ticks.inc(1)
+'''
+    vs = lint_src("minpaxos_tpu/runtime/rep.py", src, "wall-honesty")
+    assert len(vs) == 1 and "registry counter" in vs[0].msg, vs
+    assert "_c_ticks" in vs[0].msg
+
+
+def test_wall_honesty_registry_metric_name_string_fires():
+    # the counter-ish identity can live in the metric NAME string
+    src = 'def f(reg, n):\n    reg.counter("stall_ticks").inc(n)\n'
+    vs = lint_src("minpaxos_tpu/models/m2.py", src, "wall-honesty")
+    assert len(vs) == 1 and "stall_ticks" in vs[0].msg, vs
+
+
+def test_wall_honesty_registry_advance_clean_idioms():
+    """tick_inc-spelled advances and event counters (not tick-named)
+    advance freely; suppression clears a deliberate site."""
+    src = '''
+class R:
+    def _tick(self, k, n_rows):
+        tick_inc = 1
+        self._c_ticks.inc(tick_inc)
+        self._c_fused_substeps.inc(k)       # substeps, not wall ticks
+        self._c_proposals.inc(n_rows)
+        self.metrics.counter("idle_skips").inc(1)
+        self._pending.add((1, 2))           # a set, not a counter
+'''
+    assert lint_src("minpaxos_tpu/runtime/rep.py", src,
+                    "wall-honesty") == []
+    sup = ('def f(reg):\n'
+           '    reg.counter("stall_ticks").inc(2)'
+           '  # paxlint: disable=wall-honesty -- replay\n')
+    assert lint_src("minpaxos_tpu/models/m2.py", sup,
+                    "wall-honesty") == []
+
+
 # --------------------------------------------------------- broad-except
 
 

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""paxmon CI smoke: recorder-overhead guard + paxtop end-to-end check.
+
+Run by tools/run_tier1.sh right after paxlint (no JAX import, cold in
+a few seconds). Two gates:
+
+1. **Recorder-overhead guard** — the observability layer is
+   default-ON in the runtime, so its hot-path cost is a standing
+   contract: one fully-instrumented tick body (counter advances +
+   two histogram observes + one flight-recorder ring write) is
+   microbenchmarked against the same body with instrumentation off.
+   The delta must stay in the noise next to the runtime's 300-900 us
+   device-dispatch floor; the gate fails at 30 us/tick — an order of
+   magnitude above the measured few-us cost, an order below the floor
+   — so only a real regression (accidental allocation, lock on the
+   advance path, O(capacity) record) trips CI.
+
+2. **paxtop smoke** — boots a real in-process master, registers a
+   control-plane-only replica stub (a JSON-lines socket server backed
+   by a REAL MetricsRegistry + FlightRecorder seeded with all four
+   dispatch regimes), then runs ``tools/paxtop.py --once --json`` as
+   a subprocess and the master ``trace`` fan-out, validating the
+   merged Chrome trace against the trace-event schema. Every hop a
+   production paxtop uses — master fan-out verb, control socket,
+   trace merge, schema — is exercised without compiling a kernel.
+
+Exit status: 0 = both gates pass, 1 = failure (fails the build).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from minpaxos_tpu.obs.metrics import MetricsRegistry  # noqa: E402
+from minpaxos_tpu.obs.recorder import (  # noqa: E402
+    KIND_NAMES,
+    FlightRecorder,
+    validate_chrome_trace,
+)
+from minpaxos_tpu.runtime.master import (  # noqa: E402
+    Master,
+    cluster_stats,
+    cluster_trace,
+    register_with_master,
+)
+from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports  # noqa: E402
+
+# generous noise bound (seconds/tick): ~10x the measured cost on a
+# slow shared core, ~10-30x under the dispatch floor it rides next to
+OVERHEAD_BOUND_S = 30e-6
+N_ITERS = 20000
+
+
+def _tick_body(x: float) -> float:
+    """Stand-in per-tick host work, identical in both loops."""
+    return x * 1.0000001 + 0.25
+
+
+def overhead_guard() -> bool:
+    reg = MetricsRegistry("smoke")
+    tick_inc = 1  # wall-honesty spelling, as the runtime advances it
+    c_ticks = reg.counter("ticks")
+    c_disp = reg.counter("dispatches")
+    h_tick = reg.histogram("tick_wall_ms")
+    h_step = reg.histogram("device_step_ms")
+    rec = FlightRecorder(4096)
+
+    # warm both paths (allocator, bytecode caches), then measure
+    for instrumented in (False, True):
+        x = 1.0
+        for i in range(2000):
+            x = _tick_body(x)
+            if instrumented:
+                c_ticks.inc(tick_inc)
+                rec.record(i, i % 4, 1, 8, 8, i, 0, 5, 300, 20, 30, 10)
+
+    x = 1.0
+    t0 = time.perf_counter()
+    for _ in range(N_ITERS):
+        x = _tick_body(x)
+    base_s = time.perf_counter() - t0
+
+    x = 1.0
+    t0 = time.perf_counter()
+    for i in range(N_ITERS):
+        x = _tick_body(x)
+        c_ticks.inc(tick_inc)
+        c_disp.inc()
+        h_tick.observe(0.7)
+        h_step.observe(0.4)
+        rec.record(i, i % 4, 1, 8, 8, i, 0, 5, 300, 20, 30, 10)
+    inst_s = time.perf_counter() - t0
+
+    per_tick = (inst_s - base_s) / N_ITERS
+    ok = per_tick < OVERHEAD_BOUND_S
+    print(f"[obs_smoke] recorder+registry overhead: "
+          f"{per_tick * 1e6:.2f} us/tick over {N_ITERS} ticks "
+          f"(bound {OVERHEAD_BOUND_S * 1e6:.0f} us) — "
+          f"{'ok' if ok else 'FAIL'}", flush=True)
+    assert c_ticks.value == N_ITERS + 2000 and rec.total == N_ITERS + \
+        2000, "guard loops did not run instrumented"
+    return ok
+
+
+def _seed_replica_obs() -> tuple[MetricsRegistry, FlightRecorder]:
+    """A registry + recorder as a live replica would carry, with every
+    dispatch regime represented so the trace smoke covers all four."""
+    reg = MetricsRegistry("replica0")
+    tick_inc = 1
+    reg.counter("ticks").inc(40 * tick_inc)
+    reg.counter("dispatches").inc(30)
+    reg.counter("full_steps").inc(20)
+    reg.counter("fused_dispatches").inc(6)
+    reg.counter("narrow_steps").inc(4)
+    reg.counter("idle_skips").inc(10)
+    reg.counter("fused_substeps").inc(42)
+    reg.gauge("committed").set(1234)
+    h = reg.histogram("tick_wall_ms")
+    for v in (0.4, 0.7, 1.5, 3.0, 9.0):
+        h.observe(v)
+    rec = FlightRecorder(256)
+    t = 1_000_000_000
+    for i, kind in enumerate([0, 1, 2, 3] * 6):
+        t += 2_000_000
+        rec.record(t, kind, 3 if kind == 1 else 1, 8, 12, 100 + i, 2,
+                   15, 800, 120, 90, 40)
+    return reg, rec
+
+
+def _fake_replica_control(ctl_sock: socket.socket, reg, rec,
+                          stop: threading.Event) -> None:
+    """Answer ping/stats/trace on a control socket exactly like
+    runtime/replica.py's control plane (JSON lines)."""
+    def serve(conn):
+        f = conn.makefile("rw")
+        try:
+            for line in f:
+                req = json.loads(line)
+                m = req.get("m")
+                if m == "ping":
+                    resp = {"ok": True, "frontier": 123, "leader": 0,
+                            "stats": reg.counters(), "fatal": None}
+                elif m == "stats":
+                    resp = {"ok": True, "id": 0, "protocol": "minpaxos",
+                            "leader": 0, "frontier": 123,
+                            "window_base": 0, "executed": 121,
+                            "work_pending": False,
+                            "metrics": reg.snapshot(),
+                            "scalars": {"executed": 121}, "fatal": None}
+                elif m == "trace":
+                    last = req.get("last")
+                    resp = {"ok": True, "id": 0, "recorder": True,
+                            "events": rec.to_events(
+                                pid=0, last=int(last) if last else None)}
+                else:
+                    resp = {"ok": False, "error": f"unknown {m}"}
+                f.write(json.dumps(resp) + "\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    while not stop.is_set():
+        try:
+            conn, _ = ctl_sock.accept()
+        except OSError:
+            return
+        threading.Thread(target=serve, args=(conn,), daemon=True).start()
+
+
+def paxtop_smoke() -> bool:
+    # ONE selection holds all four ports (both + their +1000 siblings)
+    # simultaneously: separate calls could hand the replica a control
+    # port equal to the already-released master port (CI flake)
+    mport, dport = free_ports(2, sibling_offset=CONTROL_OFFSET)
+    master = Master("127.0.0.1", mport, 1, ping_s=30.0)
+    master.start()
+    reg, rec = _seed_replica_obs()
+    ctl = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    ctl.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ctl.bind(("127.0.0.1", dport + CONTROL_OFFSET))
+    ctl.listen(8)
+    stop = threading.Event()
+    threading.Thread(target=_fake_replica_control,
+                     args=(ctl, reg, rec, stop), daemon=True).start()
+    ok = True
+    try:
+        register_with_master(("127.0.0.1", mport), "127.0.0.1", dport,
+                             timeout_s=10.0)
+
+        # master stats fan-out reaches the replica's registry
+        stats = cluster_stats(("127.0.0.1", mport))
+        r0 = stats["replicas"][0]
+        assert r0["ok"] and r0["metrics"]["counters"]["dispatches"] == 30, r0
+
+        # master trace fan-out merges a schema-valid Chrome trace
+        # showing all four dispatch regimes
+        tr = cluster_trace(("127.0.0.1", mport), last=64)
+        errs = validate_chrome_trace(tr["trace"])
+        assert not errs, errs[:5]
+        kinds = {e["args"]["kind"] for e in tr["trace"]["traceEvents"]
+                 if e.get("cat") == "tick"}
+        assert kinds == set(KIND_NAMES), kinds
+
+        # the shipped tool, as a real subprocess: --once --json
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools/paxtop.py"),
+             "-mport", str(mport), "--once", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        payload = json.loads(out.stdout)
+        row = payload["derived"][0]
+        assert row["ok"] and row["dispatches"] == 30, row
+        assert abs(sum(row["mix_pct"].values()) - 100.0) < 1e-6, row
+        print("[obs_smoke] paxtop --once --json + trace fan-out: ok",
+              flush=True)
+    except AssertionError as e:
+        print(f"[obs_smoke] paxtop smoke FAILED: {e}", file=sys.stderr,
+              flush=True)
+        ok = False
+    finally:
+        stop.set()
+        try:
+            ctl.close()
+        except OSError:
+            pass
+        master.stop()
+    return ok
+
+
+def main() -> int:
+    ok = overhead_guard()
+    ok = paxtop_smoke() and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

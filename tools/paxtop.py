@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""paxtop — live terminal view of a minpaxos cluster (paxmon).
+
+Polls every replica THROUGH the master's ``stats`` fan-out verb
+(runtime/master.py) and renders, per replica: role, frontier and lag
+behind the cluster tip, commit throughput (delta of the ``committed``
+gauge between polls), the dispatch-regime mix (full / fused / narrow /
+idle-skip — PR 1's multi-modal tick cost, finally visible), exec
+backlog, and p50/p99 tick wall from the typed histogram.
+
+    python tools/paxtop.py -mport 7087              # live, 1s refresh
+    python tools/paxtop.py -mport 7087 -i 0.5       # faster refresh
+    python tools/paxtop.py -mport 7087 --once       # one sample, no UI
+    python tools/paxtop.py -mport 7087 --once --json  # machine output
+    python tools/paxtop.py -mport 7087 -dump-trace t.json -last 2048
+
+``-dump-trace`` pulls every replica's flight recorder through the
+master's ``trace`` verb, validates the merged Chrome trace against the
+trace-event schema, and writes a file that loads directly in Perfetto
+(ui.perfetto.dev) or chrome://tracing — the way to capture per-phase
+evidence during an A/B (PERF.md). No JAX import anywhere on this
+path: paxtop runs cold in milliseconds.
+
+Exit status: 0 = ok, 1 = cluster unreachable / invalid trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from minpaxos_tpu.obs.recorder import validate_chrome_trace  # noqa: E402
+from minpaxos_tpu.runtime.master import (  # noqa: E402
+    cluster_stats,
+    cluster_trace,
+)
+
+_REGIMES = ("full_steps", "fused_dispatches", "narrow_steps")
+
+
+def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
+    """Flatten one fan-out response into render rows, with commit
+    throughput computed against the previous poll's gauges."""
+    rows = []
+    frontiers = [r.get("frontier", -1) for r in resp.get("replicas", [])
+                 if r.get("ok")]
+    tip = max(frontiers, default=-1)
+    for r in resp.get("replicas", []):
+        rid = r.get("id", -1)
+        row = {"id": rid, "ok": bool(r.get("ok")),
+               "role": ("leader" if rid == resp.get("leader") else
+                        "replica"),
+               "protocol": r.get("protocol", "?"),
+               "frontier": r.get("frontier", -1),
+               "lag": (tip - r.get("frontier", -1)) if r.get("ok") else None,
+               "fatal": r.get("fatal"), "error": r.get("error")}
+        mx = r.get("metrics") or {}
+        counters = dict(mx.get("counters") or {})
+        counters.update(mx.get("gauges") or {})
+        disp = counters.get("dispatches", 0)
+        row["dispatches"] = disp
+        row["ticks"] = counters.get("ticks", 0)
+        row["idle_skips"] = counters.get("idle_skips", 0)
+        row["committed"] = counters.get("committed", 0)
+        scal = r.get("scalars") or {}
+        row["exec_backlog"] = (row["frontier"] + 1
+                               - (scal.get("executed", row["frontier"]) + 1))
+        row["mix_pct"] = {k.split("_")[0]: (100.0 * counters.get(k, 0)
+                                            / disp if disp else 0.0)
+                          for k in _REGIMES}
+        hist = (mx.get("histograms") or {}).get("tick_wall_ms") or {}
+        row["tick_p50_ms"] = hist.get("p50", 0.0)
+        row["tick_p99_ms"] = hist.get("p99", 0.0)
+        ops = None
+        if prev is not None and dt > 0:
+            for p in prev.get("replicas", []):
+                if p.get("id") == rid and p.get("ok") and r.get("ok"):
+                    pc = ((p.get("metrics") or {}).get("gauges") or {})
+                    ops = (row["committed"] - pc.get("committed", 0)) / dt
+        row["commits_per_s"] = ops
+        rows.append(row)
+    return rows
+
+
+def _render(resp: dict, rows: list[dict], clear: bool) -> None:
+    out = []
+    if clear:
+        out.append("\x1b[2J\x1b[H")
+    alive = sum(1 for r in rows if r["ok"])
+    out.append(f"paxtop — {alive}/{len(rows)} replicas up, "
+               f"leader={resp.get('leader')}   "
+               f"{time.strftime('%H:%M:%S')}")
+    hdr = (f"{'ID':>2} {'ROLE':<8} {'ST':<2} {'FRONTIER':>9} {'LAG':>6} "
+           f"{'COMMIT/S':>9} {'BACKLOG':>8} {'DISP':>8} {'FULL%':>6} "
+           f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'p50ms':>7} "
+           f"{'p99ms':>8}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        if not r["ok"]:
+            out.append(f"{r['id']:>2} {'?':<8} DN "
+                       f"{r.get('fatal') or r.get('error') or 'down'}")
+            continue
+        mix = r["mix_pct"]
+        ops = ("-" if r["commits_per_s"] is None
+               else f"{r['commits_per_s']:.0f}")
+        out.append(
+            f"{r['id']:>2} {r['role']:<8} ok {r['frontier']:>9} "
+            f"{r['lag']:>6} {ops:>9} {r['exec_backlog']:>8} "
+            f"{r['dispatches']:>8} {mix.get('full', 0):>6.1f} "
+            f"{mix.get('fused', 0):>6.1f} {mix.get('narrow', 0):>6.1f} "
+            f"{r['idle_skips']:>8} {r['tick_p50_ms']:>7.2f} "
+            f"{r['tick_p99_ms']:>8.2f}")
+    print("\n".join(out), flush=True)
+
+
+def _dump_trace(maddr, path: str, last: int | None) -> int:
+    resp = cluster_trace(maddr, last=last)
+    trace = resp.get("trace") or {}
+    errs = validate_chrome_trace(trace)
+    if errs:
+        print(f"paxtop: INVALID trace ({len(errs)} schema errors):",
+              file=sys.stderr)
+        for e in errs[:10]:
+            print(f"  {e}", file=sys.stderr)
+        return 1
+    Path(path).write_text(json.dumps(trace))
+    n = len(trace.get("traceEvents", []))
+    pids = sorted({e.get("pid") for e in trace.get("traceEvents", [])})
+    print(f"paxtop: wrote {n} trace events from replicas {pids} to "
+          f"{path} (open in ui.perfetto.dev or chrome://tracing)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "paxtop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("-maddr", default="127.0.0.1", help="master address")
+    p.add_argument("-mport", type=int, default=7087, help="master port")
+    p.add_argument("-i", "--interval", type=float, default=1.0,
+                   help="poll/refresh interval seconds")
+    p.add_argument("--once", action="store_true",
+                   help="print one sample and exit (no screen clearing)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw fan-out response + derived rows "
+                        "as JSON instead of the table")
+    p.add_argument("-dump-trace", default="",
+                   help="fetch + validate the merged cluster flight-"
+                        "recorder trace, write Chrome trace JSON here, "
+                        "and exit")
+    p.add_argument("-last", type=int, default=1024,
+                   help="newest recorder ticks per replica for "
+                        "-dump-trace / the TRACE verb")
+    args = p.parse_args(argv)
+    maddr = (args.maddr, args.mport)
+
+    if args.dump_trace:
+        try:
+            return _dump_trace(maddr, args.dump_trace, args.last)
+        except (OSError, ValueError) as e:
+            print(f"paxtop: trace fetch failed: {e!r}", file=sys.stderr)
+            return 1
+
+    prev, t_prev = None, 0.0
+    while True:
+        try:
+            resp = cluster_stats(maddr)
+        except (OSError, ValueError) as e:
+            print(f"paxtop: master unreachable at {maddr}: {e!r}",
+                  file=sys.stderr)
+            return 1
+        now = time.monotonic()
+        rows = _derive(resp, prev, now - t_prev if prev else 0.0)
+        if args.json:
+            print(json.dumps({"response": resp, "derived": rows}),
+                  flush=True)
+        else:
+            _render(resp, rows, clear=not args.once)
+        if args.once:
+            return 0
+        prev, t_prev = resp, now
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,14 @@ cd "$(dirname "$0")/.."
 echo "== paxlint =="
 python tools/lint.py || exit 1
 
+# paxmon smoke second: still no JAX import (~2 s). Gates the
+# recorder-overhead contract (obs is default-ON in the runtime, so a
+# hot-path regression there is a throughput regression everywhere)
+# and the paxtop --once --json / TRACE-schema end-to-end path against
+# a real master + control-plane stub (OBSERVABILITY.md).
+echo "== paxmon smoke (recorder overhead + paxtop --once --json) =="
+python tools/obs_smoke.py || exit 1
+
 if [ "${1:-}" = "smoke" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         -k "runtime_units or wire or fused" \
