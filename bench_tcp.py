@@ -79,6 +79,10 @@ RUNTIME_KNOBS = {
     "fuse_ticks": os.environ.get("BENCH_TCP_FUSE", "3"),
     "idle_fastpath": os.environ.get("BENCH_TCP_IDLEFAST", "1") != "0",
     "narrow_window": os.environ.get("BENCH_TCP_NARROW", "0"),
+    # depth-2 pipelined tick loop (default ON, the production shape);
+    # BENCH_TCP_PIPELINE=0 runs the -nopipeline leg for the paired
+    # serial-vs-pipelined A/B (PERF.md methodology: interleaved legs)
+    "pipeline": os.environ.get("BENCH_TCP_PIPELINE", "1") != "0",
     # paxmon flight recorder (default ON, the production shape);
     # BENCH_TCP_RECORDER=0 runs -norecorder for the overhead A/B
     # (acceptance: p50 + closed-loop within 3% of disabled)
@@ -92,6 +96,8 @@ def _knob_args(keyhint: int) -> list:
             "-keyhint", str(keyhint)]
     if not RUNTIME_KNOBS["idle_fastpath"]:
         args.append("-noidlefast")
+    if not RUNTIME_KNOBS["pipeline"]:
+        args.append("-nopipeline")
     if not RUNTIME_KNOBS["recorder"]:
         args.append("-norecorder")
     return args

@@ -19,10 +19,21 @@ never looked; this pass makes the convention checkable:
   ``time.sleep`` while holding a lock. Failure mode: every thread that
   needs the lock stalls behind one slow peer's TCP timeout — the
   protocol tick inherits network tail latency.
+* **donated-state read** — in ``runtime/replica.py``, any touch of
+  ``self.state`` from a method reachable from a thread target OTHER
+  than the protocol thread's ``_run``. ``self.state``'s arrays are
+  donated into the jitted step and die mid-dispatch — and under the
+  pipelined tick loop a tick's buffers are already donated while its
+  host phases are still completing, so there are MORE in-flight
+  references alive at any instant, not fewer. A control/beacon-thread
+  read races buffer donation: best case a "deleted buffer" crash,
+  worst case it silently blocks on (and reads) the WRONG tick's
+  state. Other threads must read the published ``self.snapshot`` /
+  ``stats`` instead (both are immutable-once-published).
 
 Methods never reached from a thread target (constructors, the
-protocol thread's own setup) are exempt from unlocked-write: before
-the threads exist there is nothing to race.
+protocol thread's own setup) are exempt from unlocked-write and
+donated-state: before the threads exist there is nothing to race.
 """
 
 from __future__ import annotations
@@ -36,6 +47,13 @@ RULE = "concurrency"
 SCOPE_PREFIXES = ("minpaxos_tpu/runtime/transport.py",
                   "minpaxos_tpu/runtime/master.py",
                   "minpaxos_tpu/cli/")
+
+# donated-state scope: the replica runtime, whose device state is
+# single-owner by donation (not by lock). The tick thread's entry
+# method is the one Thread target allowed to touch these attributes.
+STATE_SCOPE_PREFIXES = ("minpaxos_tpu/runtime/replica.py",)
+STATE_OWNER_ENTRY = "_run"
+DONATED_ATTRS = frozenset({"state"})
 
 _MUTATORS = frozenset({"append", "extend", "insert", "pop", "popitem",
                        "update", "clear", "remove", "discard", "add",
@@ -220,11 +238,46 @@ class _MethodChecker(ast.NodeVisitor):
         super().generic_visit(node)
 
 
+def _donated_state_reads(path: str, tree: ast.AST,
+                         out: list[Violation]) -> None:
+    """The donated-state check: in classes whose protocol thread entry
+    (``STATE_OWNER_ENTRY``) is spawned as a Thread target, any method
+    reachable from a DIFFERENT thread target must not touch the
+    donated attributes. Reads and writes alike are flagged — a read of
+    a donated buffer is already a crash-or-wrong-tick hazard."""
+    mod_targets = _thread_targets(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        facts = _ClassFacts(node)
+        targets = (mod_targets | _thread_targets(node)) & set(facts.methods)
+        if STATE_OWNER_ENTRY not in targets:
+            continue  # no protocol thread here: nothing is donated yet
+        foreign = facts.reachable_from(targets - {STATE_OWNER_ENTRY})
+        for name in sorted(foreign):
+            for n in ast.walk(facts.methods[name]):
+                attr = _is_self_attr(n)
+                if attr in DONATED_ATTRS:
+                    out.append(Violation(
+                        path, n.lineno, RULE,
+                        f"`self.{attr}` touched in `{name}`, which is "
+                        f"reachable from a thread other than the "
+                        f"protocol thread (`{STATE_OWNER_ENTRY}`) — "
+                        f"its buffers are donated into the jitted step "
+                        f"and die mid-dispatch (and the pipelined tick "
+                        f"loop keeps more of them in flight); read the "
+                        f"published snapshot/stats instead"))
+
+
 @register(RULE)
 def run(project: Project) -> list[Violation]:
     out: list[Violation] = []
     for f in project.files.values():
-        if f.tree is None or not f.path.startswith(SCOPE_PREFIXES):
+        if f.tree is None:
+            continue
+        if f.path.startswith(STATE_SCOPE_PREFIXES):
+            _donated_state_reads(f.path, f.tree, out)
+        if not f.path.startswith(SCOPE_PREFIXES):
             continue
         targets = _thread_targets(f.tree)
         for node in f.tree.body:

@@ -91,6 +91,12 @@ def main(argv=None) -> None:
     p.add_argument("-idlemaxskip", type=float, default=0.25,
                    help="idle fast path safety net: force one real"
                         " device tick at least this often (seconds)")
+    p.add_argument("-nopipeline", action="store_true",
+                   help="disable the depth-2 pipelined tick loop"
+                        " (host persist/dispatch/reply then run"
+                        " strictly after each readback instead of"
+                        " overlapping the next dispatch's device"
+                        " compute) — for A/Bs")
     p.add_argument("-narrow", type=int, default=0,
                    help="small-window specialized step: run"
                         " low-occupancy ticks through a compiled-once"
@@ -172,6 +178,7 @@ def main(argv=None) -> None:
                          idle_fastpath=not args.noidlefast,
                          idle_skip_max_s=args.idlemaxskip,
                          narrow_window=args.narrow,
+                         pipeline=not args.nopipeline,
                          key_hint=args.keyhint,
                          warm_variants=True,
                          recorder=not args.norecorder,

@@ -7,7 +7,8 @@ fixed-bucket histograms, thread-safe snapshots, zero allocation on the
 protocol thread's hot path) and a **per-tick flight recorder** (a
 fixed-size numpy ring logging dispatch kind, fused k, row counts,
 frontier, exec backlog and the per-phase wall decomposition —
-drain / device step / persist / dispatch / reply), exportable as
+drain / enqueue / readback / persist / dispatch / reply, plus the
+pipeline's device-hidden host wall as overlap_us), exportable as
 Chrome trace-event JSON loadable in Perfetto.
 
 Deliberately dependency-light (stdlib + numpy, no jax): the control
@@ -41,6 +42,7 @@ from minpaxos_tpu.obs.recorder import (
     KIND_IDLE_SKIP,
     KIND_NAMES,
     KIND_NARROW,
+    SCHEMA_VERSION,
     chrome_trace,
     validate_chrome_trace,
 )
@@ -48,6 +50,6 @@ from minpaxos_tpu.obs.recorder import (
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "TICK_MS_BUCKETS", "FlightRecorder", "KIND_FULL", "KIND_FUSED",
-    "KIND_NARROW", "KIND_IDLE_SKIP", "KIND_NAMES", "chrome_trace",
-    "validate_chrome_trace",
+    "KIND_NARROW", "KIND_IDLE_SKIP", "KIND_NAMES", "SCHEMA_VERSION",
+    "chrome_trace", "validate_chrome_trace",
 ]

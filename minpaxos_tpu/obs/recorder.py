@@ -5,14 +5,26 @@ into a preallocated int64 matrix — no allocation, no growth): when it
 happened, which dispatch regime ran (full / fused / narrow /
 idle-skip — PR 1's multi-modal tick cost), how many substeps fused,
 rows in/out, the commit frontier, the exec backlog, and the per-phase
-wall decomposition (drain / device step / persist / dispatch / reply)
-in microseconds. The ring holds the last ``capacity`` ticks; the
-control plane's TRACE verb exports it as Chrome trace-event JSON that
-loads directly in Perfetto (``ui.perfetto.dev``) or
-``chrome://tracing`` — per-phase latency decomposition is exactly
-what the "Paxos in the Cloud" experience report says deployments live
-or die by, and what PERF.md's round-6 misfire hunt had to reconstruct
-by hand from stderr.
+wall decomposition in microseconds. The ring holds the last
+``capacity`` ticks; the control plane's TRACE verb exports it as
+Chrome trace-event JSON that loads directly in Perfetto
+(``ui.perfetto.dev``) or ``chrome://tracing`` — per-phase latency
+decomposition is exactly what the "Paxos in the Cloud" experience
+report says deployments live or die by, and what PERF.md's round-6
+misfire hunt had to reconstruct by hand from stderr.
+
+Schema v2 (the pipelined tick loop): the old ``step_us`` — one blocking
+device-step+transfer wall — no longer exists as a single phase. The
+runtime now ENQUEUES the jitted step without blocking, runs the
+previous tick's host phases while the device computes, and only then
+reads the outputs back, so the dispatch splits into ``enqueue_us``
+(host wall to launch the async dispatch) and ``readback_us`` (host
+blocked on the three stacked-array transfers). ``overlap_us`` is the
+portion of THIS tick's host-phase wall (persist+dispatch+reply) that
+executed while a LATER dispatch was in flight on the device — i.e.
+host work the pipeline hid under device compute; 0 for a tick whose
+host phases ran serially after its own readback. Consumers check
+``SCHEMA_VERSION`` (carried by ``chrome_trace``) before indexing.
 
 Timestamps are ``monotonic_ns`` (CLOCK_MONOTONIC is machine-wide on
 Linux), so traces merged across the replica processes of one host
@@ -25,22 +37,42 @@ import threading
 
 import numpy as np
 
+#: ring-row layout revision; bumped whenever fields change meaning or
+#: position (v1: 12 fields with a single step_us; v2: enqueue_us /
+#: readback_us / overlap_us split, 14 fields)
+SCHEMA_VERSION = 2
+
 # dispatch regimes (runtime/replica.py classifies one per tick:
 # narrow > fused > full; idle-skip never reaches the device)
 KIND_FULL, KIND_FUSED, KIND_NARROW, KIND_IDLE_SKIP = 0, 1, 2, 3
 KIND_NAMES = ("full", "fused", "narrow", "idle_skip")
 
-# ring-row field layout (glossary in OBSERVABILITY.md)
+# ring-row field layout (glossary in OBSERVABILITY.md). Two
+# timestamps because a pipelined tick's phases occupy two wall-time
+# intervals: the dispatch phases (drain/enqueue/readback) end at
+# t_rb_ns, the host phases (persist/dispatch/reply) end at t_ns —
+# with the NEXT tick's dispatch phases in between when deferred.
+# Stamping only completion time would draw the dispatch phases where
+# they never ran and overlap consecutive tick slices in a viewer.
 (F_T_NS, F_KIND, F_K, F_ROWS_IN, F_ROWS_OUT, F_FRONTIER, F_BACKLOG,
- F_DRAIN_US, F_STEP_US, F_PERSIST_US, F_DISPATCH_US, F_REPLY_US) = range(12)
-N_FIELDS = 12
+ F_DRAIN_US, F_ENQUEUE_US, F_READBACK_US, F_OVERLAP_US, F_PERSIST_US,
+ F_DISPATCH_US, F_REPLY_US, F_T_RB_NS) = range(15)
+N_FIELDS = 15
 FIELD_NAMES = ("t_ns", "kind", "k", "rows_in", "rows_out", "frontier",
-               "exec_backlog", "drain_us", "step_us", "persist_us",
-               "dispatch_us", "reply_us")
+               "exec_backlog", "drain_us", "enqueue_us", "readback_us",
+               "overlap_us", "persist_us", "dispatch_us", "reply_us",
+               "t_rb_ns")
 
-_PHASES = (("drain", F_DRAIN_US), ("device_step", F_STEP_US),
-           ("persist", F_PERSIST_US), ("dispatch", F_DISPATCH_US),
-           ("reply", F_REPLY_US))
+# dispatch-side phases, laid end-to-end ENDING at t_rb_ns (tid 0),
+# and host-side phases ending at t_ns (tid 1 — their own track, so a
+# deferred tick's host work rendered under the next tick's dispatch
+# slice is the overlap made visible). overlap_us is in NEITHER list:
+# it is an attribute of the host walls (how much was device-hidden),
+# not an additional phase — it rides the tick args + a counter track.
+_DISPATCH_PHASES = (("drain", F_DRAIN_US), ("enqueue", F_ENQUEUE_US),
+                    ("readback", F_READBACK_US))
+_HOST_PHASES = (("persist", F_PERSIST_US), ("dispatch", F_DISPATCH_US),
+                ("reply", F_REPLY_US))
 
 _EVENT_PHASES = frozenset("XBEiICMsnbe")  # trace-event ph codes we accept
 
@@ -64,12 +96,18 @@ class FlightRecorder:
 
     def record(self, t_ns: int, kind: int, k: int, rows_in: int,
                rows_out: int, frontier: int, backlog: int, drain_us: int,
-               step_us: int, persist_us: int, dispatch_us: int,
-               reply_us: int) -> None:
+               enqueue_us: int, readback_us: int, overlap_us: int,
+               persist_us: int, dispatch_us: int, reply_us: int,
+               t_rb_ns: int = 0) -> None:
+        """``t_ns``: when the tick's host phases completed. ``t_rb_ns``:
+        when its readback completed (0 = unknown; to_events then lays
+        the dispatch phases contiguously before the host phases, which
+        is exact for serial ticks)."""
         with self._lock:
             self._buf[self.total % self.capacity] = (
                 t_ns, kind, k, rows_in, rows_out, frontier, backlog,
-                drain_us, step_us, persist_us, dispatch_us, reply_us)
+                drain_us, enqueue_us, readback_us, overlap_us,
+                persist_us, dispatch_us, reply_us, t_rb_ns)
             self.total += 1
 
     def snapshot(self, last: int | None = None) -> np.ndarray:
@@ -87,34 +125,52 @@ class FlightRecorder:
         return out
 
     def to_events(self, pid: int = 0, last: int | None = None) -> list[dict]:
-        """Chrome trace events for the recorded rows: one enclosing
-        ``X`` (complete) event per tick carrying the row's args, child
-        ``X`` events for each non-zero phase laid end-to-end inside
-        it, and ``C`` (counter) events for frontier / exec backlog.
-        ``pid`` should be the replica id so merged cluster traces get
-        one track group per replica."""
+        """Chrome trace events for the recorded rows, at the times the
+        phases actually ran: the enclosing ``X`` tick event plus the
+        drain/enqueue/readback children end at ``t_rb_ns`` on tid 0
+        (the dispatch track), the persist/dispatch/reply children end
+        at ``t_ns`` on tid 1 (the host-phase track) — so a deferred
+        tick's host work renders UNDER the next tick's dispatch slice
+        instead of producing overlapping same-track slices, and the
+        pipeline's overlap is visible as exactly that. ``C`` (counter)
+        events graph frontier / exec backlog / ``overlap_us``. ``pid``
+        should be the replica id so merged cluster traces get one
+        track group per replica."""
         events: list[dict] = []
         for r in self.snapshot(last):
-            dur = sum(int(r[i]) for _, i in _PHASES)
+            disp_dur = sum(int(r[i]) for _, i in _DISPATCH_PHASES)
+            host_dur = sum(int(r[i]) for _, i in _HOST_PHASES)
             t_end = int(r[F_T_NS]) / 1e3  # trace-event ts unit: us
-            t0 = t_end - dur
+            t_rb = (int(r[F_T_RB_NS]) / 1e3 if r[F_T_RB_NS] > 0
+                    else t_end - host_dur)  # pre-v2 rows: contiguous
+            t0 = t_rb - disp_dur
             kind = KIND_NAMES[int(r[F_KIND])]
             events.append({
                 "name": f"tick:{kind}", "cat": "tick", "ph": "X",
-                "ts": t0, "dur": max(dur, 1), "pid": pid, "tid": 0,
+                "ts": t0, "dur": max(disp_dur, 1), "pid": pid, "tid": 0,
                 "args": {"kind": kind, "k": int(r[F_K]),
                          "rows_in": int(r[F_ROWS_IN]),
                          "rows_out": int(r[F_ROWS_OUT]),
                          "frontier": int(r[F_FRONTIER]),
-                         "exec_backlog": int(r[F_BACKLOG])}})
+                         "exec_backlog": int(r[F_BACKLOG]),
+                         "host_us": host_dur,
+                         "overlap_us": int(r[F_OVERLAP_US])}})
             if int(r[F_KIND]) != KIND_IDLE_SKIP:
                 t = t0
-                for name, i in _PHASES:
+                for name, i in _DISPATCH_PHASES:
                     d = int(r[i])
                     if d > 0:
                         events.append({"name": name, "cat": "phase",
                                        "ph": "X", "ts": t, "dur": d,
                                        "pid": pid, "tid": 0})
+                    t += d
+                t = t_end - host_dur
+                for name, i in _HOST_PHASES:
+                    d = int(r[i])
+                    if d > 0:
+                        events.append({"name": name, "cat": "phase",
+                                       "ph": "X", "ts": t, "dur": d,
+                                       "pid": pid, "tid": 1})
                     t += d
             events.append({"name": "frontier", "ph": "C", "ts": t_end,
                            "pid": pid, "tid": 0,
@@ -122,12 +178,18 @@ class FlightRecorder:
             events.append({"name": "exec_backlog", "ph": "C", "ts": t_end,
                            "pid": pid, "tid": 0,
                            "args": {"exec_backlog": int(r[F_BACKLOG])}})
+            events.append({"name": "overlap_us", "ph": "C", "ts": t_end,
+                           "pid": pid, "tid": 0,
+                           "args": {"overlap_us": int(r[F_OVERLAP_US])}})
         return events
 
 
 def chrome_trace(events: list[dict]) -> dict:
-    """Wrap an event list in the trace-event JSON object format."""
-    return {"traceEvents": list(events), "displayTimeUnit": "ms"}
+    """Wrap an event list in the trace-event JSON object format. The
+    paxmon schema revision rides ``otherData`` (viewers ignore it;
+    ``validate_chrome_trace`` and offline consumers check it)."""
+    return {"traceEvents": list(events), "displayTimeUnit": "ms",
+            "otherData": {"paxmonSchemaVersion": SCHEMA_VERSION}}
 
 
 def validate_chrome_trace(trace) -> list[str]:
@@ -137,9 +199,13 @@ def validate_chrome_trace(trace) -> list[str]:
     the JSON-object form with a ``traceEvents`` list, and per event a
     string ``name``, a known ``ph`` code, numeric ``ts``, integer
     ``pid``/``tid``, a numeric non-negative ``dur`` on complete (X)
-    events, and an ``args`` object of numbers on counter (C) events.
-    Used by the tests, ``tools/obs_smoke.py`` and paxtop's trace dump
-    so a malformed export fails loudly at the source, not in a viewer.
+    events, and an ``args`` object of numbers on counter (C) events —
+    plus the paxmon schema revision when stamped: a trace produced by
+    a different ring layout (``otherData.paxmonSchemaVersion`` !=
+    SCHEMA_VERSION) fails validation instead of silently mislabeling
+    phases in a viewer. Used by the tests, ``tools/obs_smoke.py`` and
+    paxtop's trace dump so a malformed export fails loudly at the
+    source, not in a viewer.
     """
     errs: list[str] = []
     if not isinstance(trace, dict):
@@ -147,6 +213,12 @@ def validate_chrome_trace(trace) -> list[str]:
     evs = trace.get("traceEvents")
     if not isinstance(evs, list):
         return ["missing/non-list traceEvents"]
+    other = trace.get("otherData")
+    if isinstance(other, dict) and "paxmonSchemaVersion" in other:
+        ver = other["paxmonSchemaVersion"]
+        if ver != SCHEMA_VERSION:
+            errs.append(f"paxmon schema version mismatch: trace has "
+                        f"{ver!r}, this build reads {SCHEMA_VERSION}")
     for i, ev in enumerate(evs):
         where = f"event[{i}]"
         if not isinstance(ev, dict):

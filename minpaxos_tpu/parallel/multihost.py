@@ -44,6 +44,22 @@ def initialize(coordinator_address: str | None = None,
     """
     if coordinator_address is None and num_processes in (None, 1):
         return
+    # CPU multi-controller needs an explicit cross-process collectives
+    # backend: XLA's default CPU client refuses multiprocess
+    # computations outright ("Multiprocess computations aren't
+    # implemented on the CPU backend"), which made the two-process
+    # SPMD test fail on every CPU-only host. jaxlib ships a gloo
+    # transport for exactly this; selecting it is only valid BEFORE
+    # backends initialize, so do it here, keyed on the requested
+    # platform (TPU/GPU jobs keep their native collectives).
+    platforms = jax.config.jax_platforms or ""
+    if "cpu" in platforms.split(","):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            # older jaxlib without the option/transport: proceed; the
+            # initialize below then reports the real capability error
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -91,10 +91,10 @@ def test_histogram_percentiles_and_bad_bounds():
 def test_recorder_ring_wraparound_keeps_newest_in_order():
     rec = FlightRecorder(8)
     for i in range(20):
-        rec.record(1000 * i, KIND_FULL, 1, i, 0, i, 0, 1, 2, 3, 4, 5)
+        rec.record(1000 * i, KIND_FULL, 1, i, 0, i, 0, 1, 2, 3, 0, 4, 5, 6)
     assert rec.total == 20
     snap = rec.snapshot()
-    assert snap.shape == (8, 12)
+    assert snap.shape == (8, 15)
     # newest 8 rows, oldest-first (timestamps strictly increasing)
     np.testing.assert_array_equal(snap[:, 0],
                                   [1000 * i for i in range(12, 20)])
@@ -112,8 +112,10 @@ def test_trace_export_all_four_regimes_validates():
     for i, kind in enumerate([KIND_FULL, KIND_FUSED, KIND_NARROW,
                               KIND_IDLE_SKIP] * 4):
         t += 2_000_000
+        # pipelined rows (every other) carry a hidden host wall
         rec.record(t, kind, 3 if kind == KIND_FUSED else 1, 8, 12,
-                   100 + i, 2, 15, 800, 120, 90, 40)
+                   100 + i, 2, 15, 30, 700, 250 if i % 2 else 0,
+                   120, 90, 40)
     events = rec.to_events(pid=2)
     trace = chrome_trace(events)
     assert validate_chrome_trace(trace) == []
@@ -122,15 +124,46 @@ def test_trace_export_all_four_regimes_validates():
     assert {e["args"]["kind"] for e in ticks} == set(KIND_NAMES)
     assert all(e["pid"] == 2 for e in events)
     # per-phase children exist for device ticks, not for idle skips
+    # (schema v2: the blocking step_us is gone; the dispatch splits
+    # into enqueue + readback, and the hidden host wall rides
+    # overlap_us on the tick args + its own counter track)
     names = {e["name"] for e in events}
-    assert {"device_step", "persist", "dispatch", "reply"} <= names
+    assert {"enqueue", "readback", "persist", "dispatch", "reply"} <= names
+    assert "device_step" not in names and "step_us" not in names
+    assert {e["args"]["overlap_us"] for e in ticks} == {0, 250}
+    # two-track rendering: dispatch phases on tid 0, host phases on
+    # tid 1 (a deferred tick's host work then renders under the next
+    # tick's dispatch slice instead of overlapping it on one track)
+    phase_tid = {e["name"]: e["tid"] for e in events
+                 if e.get("cat") == "phase"}
+    assert phase_tid["enqueue"] == 0 and phase_tid["readback"] == 0
+    assert phase_tid["persist"] == 1 and phase_tid["reply"] == 1
     skips = [e for e in ticks if e["args"]["kind"] == "idle_skip"]
     assert skips and all(e["args"]["k"] == 1 for e in ticks
                          if e["args"]["kind"] == "full")
-    # counter events carry numeric args (what Perfetto graphs)
+    # counter events carry numeric args (what Perfetto graphs);
+    # overlap_us is one of the counter tracks
     cs = [e for e in events if e["ph"] == "C"]
     assert cs and all(isinstance(v, int) for e in cs
                       for v in e["args"].values())
+    assert any(e["name"] == "overlap_us" for e in cs)
+
+
+def test_trace_schema_version_stamped_and_checked():
+    """chrome_trace stamps the ring-layout revision; a trace from a
+    different layout must fail validation instead of silently
+    mislabeling phases in a viewer."""
+    from minpaxos_tpu.obs.recorder import SCHEMA_VERSION
+
+    tr = chrome_trace([])
+    assert tr["otherData"]["paxmonSchemaVersion"] == SCHEMA_VERSION == 2
+    assert validate_chrome_trace(tr) == []
+    stale = chrome_trace([])
+    stale["otherData"]["paxmonSchemaVersion"] = 1
+    errs = validate_chrome_trace(stale)
+    assert errs and "schema version mismatch" in errs[0]
+    # traces without the stamp (e.g. hand-built fixtures) still pass
+    assert validate_chrome_trace({"traceEvents": []}) == []
 
 
 def test_trace_validator_rejects_malformed_events():

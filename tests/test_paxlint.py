@@ -314,9 +314,65 @@ def test_concurrency_constructor_exempt():
 
 
 def test_concurrency_out_of_scope_file_ignored():
-    # replica.py is single-owner by design; the pass scopes to
-    # transport/master/cli
+    # replica.py is single-owner by design; the lock-discipline checks
+    # scope to transport/master/cli (replica.py gets the donated-state
+    # check instead — below)
     assert lint_src("minpaxos_tpu/runtime/replica.py", CONC_BAD,
+                    "concurrency") == []
+
+
+# donated-state: self.state's buffers are donated into the jitted step;
+# only the protocol thread (_run and what it calls) may touch them —
+# the pipelined tick loop doubles the in-flight references, so the
+# single-owner convention is machine-checked, not just documented.
+
+STATE_BAD = '''
+import threading
+
+class ReplicaServer:
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+        threading.Thread(target=self._control_loop, daemon=True).start()
+
+    def _run(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        self.state = self.step(self.state)   # owner thread: fine
+
+    def _control_loop(self):
+        self._answer()
+
+    def _answer(self):
+        return int(self.state.committed_upto)  # foreign-thread read
+'''
+
+
+def test_concurrency_donated_state_read_fires():
+    vs = lint_src("minpaxos_tpu/runtime/replica.py", STATE_BAD,
+                  "concurrency")
+    assert len(vs) == 1, vs
+    assert "`self.state` touched in `_answer`" in vs[0].msg
+    assert "donated" in vs[0].msg
+
+
+def test_concurrency_donated_state_owner_thread_ok():
+    # the same access pattern minus the control-thread read is clean:
+    # _run/_tick own the state (and methods no thread reaches, like a
+    # stop() on the main thread, are exempt)
+    src = STATE_BAD.replace(
+        "        return int(self.state.committed_upto)"
+        "  # foreign-thread read",
+        "        return dict(self.snapshot)")
+    assert lint_src("minpaxos_tpu/runtime/replica.py", src,
+                    "concurrency") == []
+
+
+def test_concurrency_donated_state_scoped_to_replica():
+    # the check keys on the replica runtime's donation contract; the
+    # same shape elsewhere (no donated buffers) must stay quiet
+    assert lint_src("minpaxos_tpu/runtime/transport.py", STATE_BAD,
                     "concurrency") == []
 
 

@@ -1,0 +1,307 @@
+"""The pipelined tick loop's equality and durability contracts
+(runtime/replica.py `_device_tick` / `_finish_host`).
+
+The pipeline's claim is REORDERING, not approximation: deferring a
+tick's host phases under the next tick's device compute must produce
+byte-identical replies (content and per-connection order) and
+leaf-identical device state versus the strictly serial `-nopipeline`
+order, over any trace. These tests drive two replica servers — one
+per mode — through the same randomized multi-tick trace WITHOUT their
+protocol threads (the test owns the tick loop, so both runs see
+identical inputs), then compare everything. The `-durable` half pins
+the fsync-before-reply ordering per tick, including at a simulated
+crash point between a tick's dispatch and its deferred host phases.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+from minpaxos_tpu.runtime.replica import CONTROL, ReplicaServer, RuntimeFlags
+from minpaxos_tpu.runtime.transport import FROM_CLIENT
+from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
+
+CID = 7  # the one client connection id both runs use
+
+CFG = MinPaxosConfig(n_replicas=1, window=128, inbox=16, exec_batch=8,
+                     kv_pow2=8, catchup_rows=8, recovery_rows=8,
+                     gossip_ticks=1)
+
+
+def _mk_server(tmp_path, name: str, pipeline: bool,
+               durable: bool = False) -> ReplicaServer:
+    """A single-replica server with NO threads/sockets started: the
+    test drives _drain/_device_tick itself, so pipelined and serial
+    runs consume byte-identical tick sequences."""
+    d = tmp_path / name
+    d.mkdir()
+    flags = RuntimeFlags(pipeline=pipeline, durable=durable,
+                         store_dir=str(d))
+    return ReplicaServer(0, [("127.0.0.1", 7077)], CFG, flags)
+
+
+def _capture_replies(srv: ReplicaServer, log: list) -> None:
+    srv.transport.send_client = (  # type: ignore[method-assign]
+        lambda cid, kind, rows: log.append((cid, int(kind), rows.copy()))
+        or True)
+
+
+def _elect(srv: ReplicaServer) -> None:
+    srv.queue.put((CONTROL, 0, "be_the_leader", None))
+    for _ in range(20):
+        if srv._drain(0.001):
+            srv._become_leader()
+        srv._device_tick(srv.inbox)
+        if srv.snapshot["prepared"]:
+            return
+    raise AssertionError(f"never prepared: {srv.snapshot}")
+
+
+def _trace(n_frames: int, rows: int, seed: int) -> list[np.ndarray]:
+    """Randomized PROPOSE frames with globally unique cmd_ids and a
+    PUT/GET mix over a small key space (GETs observe earlier PUTs, so
+    reply VALUES depend on execution order — a reordering bug shows up
+    in the payload, not just the stream shape)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for f in range(n_frames):
+        ops = rng.choice([int(Op.PUT), int(Op.GET)], size=rows,
+                         p=[0.7, 0.3])
+        out.append(make_batch(
+            MsgKind.PROPOSE,
+            cmd_id=(1000 + f * rows + np.arange(rows)).astype(np.int32),
+            op=ops.astype(np.uint8),
+            key=rng.integers(0, 40, rows).astype(np.int64),
+            val=rng.integers(1, 1 << 20, rows).astype(np.int64),
+            timestamp=0))
+    return out
+
+
+def _run_trace(srv: ReplicaServer, trace: list[np.ndarray],
+               extra_ticks: int = 12) -> list:
+    """Feed the whole trace through the queue (so the pipelined run
+    sees queued follow-up traffic — the defer condition), then a FIXED
+    number of drain+tick rounds: both modes execute the same number of
+    dispatches, keeping device tick counters comparable."""
+    replies: list = []
+    _capture_replies(srv, replies)
+    _elect(srv)
+    for frame in trace:
+        srv.queue.put((FROM_CLIENT, CID, MsgKind.PROPOSE, frame))
+    for _ in range(3 * len(trace) + extra_ticks):
+        srv._drain(0.001)
+        srv._device_tick(srv.inbox)
+    srv._flush_inflight()
+    return replies
+
+
+def _assert_replies_equal(a: list, b: list) -> None:
+    assert len(a) == len(b), (len(a), len(b))
+    for i, ((cid_a, kind_a, rows_a), (cid_b, kind_b, rows_b)) in enumerate(
+            zip(a, b)):
+        assert (cid_a, kind_a) == (cid_b, kind_b), i
+        for f in rows_a.dtype.names:
+            if f == "timestamp":
+                continue  # wall-clock stamp: the one intended delta
+            np.testing.assert_array_equal(rows_a[f], rows_b[f],
+                                          err_msg=f"reply {i} field {f}")
+
+
+def test_pipelined_equals_serial_over_randomized_trace(tmp_path):
+    """Leaf-for-leaf state + reply-stream equality, pipelined vs
+    -nopipeline, over a randomized multi-tick PUT/GET trace — and the
+    pipelined run must actually have deferred host phases (else this
+    proves nothing)."""
+    trace = _trace(n_frames=6, rows=CFG.inbox, seed=11)
+    srv_p = _mk_server(tmp_path, "pipe", pipeline=True)
+    srv_s = _mk_server(tmp_path, "serial", pipeline=False)
+    try:
+        rep_p = _run_trace(srv_p, trace)
+        rep_s = _run_trace(srv_s, trace)
+        assert srv_p.stats["pipelined_ticks"] > 0, srv_p.stats
+        assert srv_s.stats["pipelined_ticks"] == 0, srv_s.stats
+        # every admitted command was replied to, exactly once
+        n_cmds = sum(len(rep[2]["cmd_id"]) for rep in rep_p
+                     if rep[1] == int(MsgKind.PROPOSE_REPLY))
+        assert n_cmds == 6 * CFG.inbox
+        _assert_replies_equal(rep_p, rep_s)
+        assert srv_p.snapshot == srv_s.snapshot
+        for leaf_p, leaf_s in zip(
+                jax.tree_util.tree_leaves(srv_p.state),
+                jax.tree_util.tree_leaves(srv_s.state)):
+            np.testing.assert_array_equal(np.asarray(leaf_p),
+                                          np.asarray(leaf_s))
+        # the dispatch-regime mix is part of the equality claim too:
+        # the pipeline must not change WHAT was dispatched, only when
+        # host phases ran
+        for key in ("dispatches", "full_steps", "fused_dispatches",
+                    "narrow_steps", "proposals", "executed"):
+            assert srv_p.stats[key] == srv_s.stats[key], key
+    finally:
+        srv_p.store.close()
+        srv_s.store.close()
+
+
+def test_durable_no_reply_precedes_its_ticks_fsync(tmp_path):
+    """-durable ordering through the pipeline: at the instant any
+    reply frame is handed to the transport, the store must have NO
+    unflushed records (this tick's accepted/committed slots were
+    already fsynced) — for immediate AND deferred host phases."""
+    srv = _mk_server(tmp_path, "durable", pipeline=True, durable=True)
+    dirty = [False]
+    violations = []
+    store = srv.store
+    orig_slots, orig_front = store.append_slots, store.append_frontier
+    orig_flush = store.flush
+
+    def slots(*a, **kw):
+        dirty[0] = True
+        return orig_slots(*a, **kw)
+
+    def front(committed_upto):
+        # append_frontier no-ops at/below the recorded frontier
+        if committed_upto > store.frontier:
+            dirty[0] = True
+        return orig_front(committed_upto)
+
+    def flush():
+        dirty[0] = False
+        return orig_flush()
+
+    store.append_slots, store.append_frontier = slots, front
+    store.flush = flush
+
+    def send_client(cid, kind, rows):
+        if dirty[0]:
+            violations.append((cid, int(kind), rows["cmd_id"].tolist()))
+        return True
+
+    srv.transport.send_client = send_client  # type: ignore[method-assign]
+    try:
+        _elect(srv)
+        for frame in _trace(n_frames=4, rows=CFG.inbox, seed=23):
+            srv.queue.put((FROM_CLIENT, CID, MsgKind.PROPOSE, frame))
+        for _ in range(24):
+            srv._drain(0.001)
+            srv._device_tick(srv.inbox)
+        srv._flush_inflight()
+        assert violations == []
+        assert srv.stats["pipelined_ticks"] > 0  # the deferred path ran
+        assert srv.stats["executed"] == 4 * CFG.inbox
+    finally:
+        srv.store.close()
+
+
+def test_durable_crash_point_loses_reply_and_persist_together(tmp_path):
+    """Simulated crash between a tick's dispatch and its DEFERRED host
+    phases (the new window the pipeline opens): the tick's replies
+    must not have left — reply strictly follows persist+fsync in
+    program order, so a crash can lose both but never the reply
+    alone. The client treats the silence as unacked and retries."""
+    srv = _mk_server(tmp_path, "crash", pipeline=True, durable=True)
+    replies: list = []
+    _capture_replies(srv, replies)
+    flushes = [0]
+    orig_flush = srv.store.flush
+    srv.store.flush = lambda: flushes.__setitem__(0, flushes[0] + 1) or orig_flush()
+    try:
+        _elect(srv)
+        n_before = len(replies)
+        f_before = flushes[0]
+        # two frames queued: tick 1 processes frame 1 and DEFERS its
+        # host phases (queue non-empty)...
+        for frame in _trace(n_frames=2, rows=CFG.inbox, seed=31):
+            srv.queue.put((FROM_CLIENT, CID, MsgKind.PROPOSE, frame))
+        srv._drain(0.001)
+        srv._device_tick(srv.inbox)
+        assert srv._inflight is not None  # host phases pending
+        # ...crash here: the deferred tick's persist AND replies are
+        # both lost — neither happened yet
+        assert len(replies) == n_before
+        assert flushes[0] == f_before
+        srv._inflight = None  # the crash drops the in-flight work
+    finally:
+        srv.store.close()
+
+
+def test_narrow_anchor_validation_quiet_on_legit_traffic(tmp_path):
+    """The post-readback anchor validation must not false-positive on
+    ordinary narrow-view traffic (a spurious fallback would disable
+    the narrow win every other dispatch): drive proposes through a
+    narrow-windowed pipelined server; narrow dispatches happen, zero
+    fallbacks, and the doubt flag stays clear."""
+    d = tmp_path / "narrow"
+    d.mkdir()
+    flags = RuntimeFlags(pipeline=True, narrow_window=32, store_dir=str(d))
+    srv = ReplicaServer(0, [("127.0.0.1", 7077)], CFG, flags)
+    _capture_replies(srv, [])
+    try:
+        _elect(srv)
+        for frame in _trace(n_frames=3, rows=CFG.inbox, seed=17):
+            srv.queue.put((FROM_CLIENT, CID, MsgKind.PROPOSE, frame))
+        for _ in range(18):
+            srv._drain(0.001)
+            srv._device_tick(srv.inbox)
+        srv._flush_inflight()
+        assert srv.stats["narrow_steps"] > 0, srv.stats
+        assert srv.stats["narrow_fallbacks"] == 0, srv.stats
+        assert not srv._narrow_doubt
+        assert srv.stats["executed"] == 3 * CFG.inbox
+    finally:
+        srv.store.close()
+
+
+def test_nopipeline_flag_reaches_runtime_flags():
+    """cli/server.py wires -nopipeline into RuntimeFlags.pipeline
+    (parse-only: the flag is the documented A/B escape hatch)."""
+    import argparse
+
+    from minpaxos_tpu.cli import server as cli_server
+
+    # reuse the real parser by probing a tiny shim: build the parser
+    # the same way main() does, but stop at parse_args
+    p = argparse.ArgumentParser()
+    p.add_argument("-nopipeline", action="store_true")
+    assert p.parse_args([]).nopipeline is False
+    assert p.parse_args(["-nopipeline"]).nopipeline is True
+    # and the flag text is present in the CLI module
+    import inspect
+
+    src = inspect.getsource(cli_server)
+    assert "-nopipeline" in src and "pipeline=not args.nopipeline" in src
+
+
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_tick_counters_and_recorder_fields(tmp_path, pipeline):
+    """Both modes record schema-v2 rows: enqueue/readback always
+    populated; overlap_us > 0 only where host phases were deferred."""
+    from minpaxos_tpu.obs.recorder import (
+        F_ENQUEUE_US,
+        F_OVERLAP_US,
+        F_READBACK_US,
+    )
+
+    srv = _mk_server(tmp_path, f"rec{int(pipeline)}", pipeline=pipeline)
+    _capture_replies(srv, [])
+    try:
+        _elect(srv)
+        for frame in _trace(n_frames=3, rows=CFG.inbox, seed=5):
+            srv.queue.put((FROM_CLIENT, CID, MsgKind.PROPOSE, frame))
+        for _ in range(18):
+            srv._drain(0.001)
+            srv._device_tick(srv.inbox)
+        srv._flush_inflight()
+        rows = srv.recorder.snapshot()
+        assert (rows[:, F_ENQUEUE_US] > 0).all()
+        assert (rows[:, F_READBACK_US] >= 0).all()
+        overlapped = rows[:, F_OVERLAP_US] > 0
+        if pipeline:
+            assert overlapped.any()
+            assert int(overlapped.sum()) == srv.stats["pipelined_ticks"]
+        else:
+            assert not overlapped.any()
+    finally:
+        srv.store.close()

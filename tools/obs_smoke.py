@@ -74,14 +74,19 @@ def overhead_guard() -> bool:
     h_step = reg.histogram("device_step_ms")
     rec = FlightRecorder(4096)
 
-    # warm both paths (allocator, bytecode caches), then measure
+    # warm both paths (allocator, bytecode caches), then measure.
+    # The record call carries the schema-v2 pipelined row (enqueue /
+    # readback / overlap split + the readback timestamp): the overhead
+    # contract covers the 15-field write the pipelined runtime
+    # actually performs.
     for instrumented in (False, True):
         x = 1.0
         for i in range(2000):
             x = _tick_body(x)
             if instrumented:
                 c_ticks.inc(tick_inc)
-                rec.record(i, i % 4, 1, 8, 8, i, 0, 5, 300, 20, 30, 10)
+                rec.record(i, i % 4, 1, 8, 8, i, 0, 5, 30, 270, 60,
+                           20, 30, 10, i)
 
     x = 1.0
     t0 = time.perf_counter()
@@ -97,7 +102,7 @@ def overhead_guard() -> bool:
         c_disp.inc()
         h_tick.observe(0.7)
         h_step.observe(0.4)
-        rec.record(i, i % 4, 1, 8, 8, i, 0, 5, 300, 20, 30, 10)
+        rec.record(i, i % 4, 1, 8, 8, i, 0, 5, 30, 270, 60, 20, 30, 10, i)
     inst_s = time.perf_counter() - t0
 
     per_tick = (inst_s - base_s) / N_ITERS
@@ -113,7 +118,11 @@ def overhead_guard() -> bool:
 
 def _seed_replica_obs() -> tuple[MetricsRegistry, FlightRecorder]:
     """A registry + recorder as a live replica would carry, with every
-    dispatch regime represented so the trace smoke covers all four."""
+    dispatch regime represented so the trace smoke covers all four —
+    and both pipeline modes: even rows are serial (overlap_us = 0),
+    odd rows are pipelined (host phases hidden under the next
+    dispatch's compute), so the end-to-end trace leg exercises the
+    schema-v2 enqueue/readback/overlap fields."""
     reg = MetricsRegistry("replica0")
     tick_inc = 1
     reg.counter("ticks").inc(40 * tick_inc)
@@ -123,6 +132,7 @@ def _seed_replica_obs() -> tuple[MetricsRegistry, FlightRecorder]:
     reg.counter("narrow_steps").inc(4)
     reg.counter("idle_skips").inc(10)
     reg.counter("fused_substeps").inc(42)
+    reg.counter("pipelined_ticks").inc(12)
     reg.gauge("committed").set(1234)
     h = reg.histogram("tick_wall_ms")
     for v in (0.4, 0.7, 1.5, 3.0, 9.0):
@@ -132,7 +142,8 @@ def _seed_replica_obs() -> tuple[MetricsRegistry, FlightRecorder]:
     for i, kind in enumerate([0, 1, 2, 3] * 6):
         t += 2_000_000
         rec.record(t, kind, 3 if kind == 1 else 1, 8, 12, 100 + i, 2,
-                   15, 800, 120, 90, 40)
+                   15, 40, 760, 250 if i % 2 else 0, 120, 90, 40,
+                   t - 300_000)
     return reg, rec
 
 
@@ -207,13 +218,23 @@ def paxtop_smoke() -> bool:
         assert r0["ok"] and r0["metrics"]["counters"]["dispatches"] == 30, r0
 
         # master trace fan-out merges a schema-valid Chrome trace
-        # showing all four dispatch regimes
+        # showing all four dispatch regimes AND both pipeline modes
+        # (schema v2: enqueue/readback child phases, overlap_us args
+        # + counter track — the pipelined-mode leg of this smoke)
         tr = cluster_trace(("127.0.0.1", mport), last=64)
         errs = validate_chrome_trace(tr["trace"])
         assert not errs, errs[:5]
-        kinds = {e["args"]["kind"] for e in tr["trace"]["traceEvents"]
-                 if e.get("cat") == "tick"}
+        evs = tr["trace"]["traceEvents"]
+        kinds = {e["args"]["kind"] for e in evs if e.get("cat") == "tick"}
         assert kinds == set(KIND_NAMES), kinds
+        phase_names = {e["name"] for e in evs if e.get("cat") == "phase"}
+        assert {"enqueue", "readback"} <= phase_names, phase_names
+        assert "device_step" not in phase_names, phase_names
+        overlaps = {e["args"]["overlap_us"] for e in evs
+                    if e.get("cat") == "tick"}
+        assert 0 in overlaps and max(overlaps) > 0, overlaps
+        assert any(e["name"] == "overlap_us" for e in evs
+                   if e.get("ph") == "C")
 
         # the shipped tool, as a real subprocess: --once --json
         out = subprocess.run(
