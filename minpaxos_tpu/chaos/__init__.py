@@ -1,0 +1,36 @@
+"""paxchaos: deterministic network fault injection + invariant checking.
+
+The safety argument of Paxos is about *messy* failures — lost, delayed,
+duplicated and reordered messages between live replicas, and asymmetric
+partitions that never fully kill anyone ("Paxos in the Cloud",
+PAPERS.md) — yet every failure the kill/revive harnesses exercise is a
+clean process death. This package makes the messy failures a first-
+class, *reproducible* test input:
+
+* ``plan``     — :class:`FaultPlan` / :class:`LinkPolicy`: per-directed-
+  link drop / delay+jitter / duplicate / reorder / block policies, all
+  driven by seeded ``np.random.Generator`` streams so a failing
+  campaign replays exactly from its seed.
+* ``shim``     — :class:`ChaosShim`: the injection point the TCP
+  transport consults in ``send_peer`` (outbound partition blackhole)
+  and ``_read_loop`` (inbound drop/delay/dup/reorder). Guaranteed
+  no-op when not installed: one attribute load per frame, zero
+  allocation.
+* ``check``    — cluster invariant checker: byte-level committed-slot
+  agreement across replicas' durable logs, frontier monotonicity, and
+  per-key linearizability of the client's exactly-once history.
+* ``campaign`` — seeded fault schedules + the in-process campaign
+  runner behind ``tools/chaos.py`` (imported directly, not re-exported
+  here: it pulls in the replica runtime and JAX).
+
+Fault model scope: replica<->replica data-plane links only. Client and
+control-plane (master ping / control verb) connections are never
+faulted — the checker and the healing RPCs must stay reachable, and
+client failover is exercised indirectly by what the peer faults do to
+commit progress.
+"""
+
+from minpaxos_tpu.chaos.plan import FaultPlan, LinkPolicy
+from minpaxos_tpu.chaos.shim import ChaosShim
+
+__all__ = ["FaultPlan", "LinkPolicy", "ChaosShim"]

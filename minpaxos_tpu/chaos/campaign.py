@@ -1,0 +1,416 @@
+"""Seeded chaos campaigns: boot a cluster, hurt the network, check it.
+
+A *schedule* is a deterministic list of timed chaos events —
+``(t_offset_s, "install"|"clear", plan_dict)`` — built from a name and
+a seed by :func:`build_schedule`: same (name, seed, n) always yields
+byte-identical events (the RNG stream is keyed by ``[seed,
+crc32(name)]``, never the wall clock), and the plan's own network
+decisions are keyed by a sub-seed drawn from the same stream. A
+failing campaign therefore replays exactly from the seed it prints.
+
+The runner boots a REAL in-process cluster (master + N ReplicaServer
+threads + TCP sockets, the same shape as tests/test_distributed.py),
+drives closed-loop load from a ``-check`` client while applying the
+schedule through the master's ``cluster_chaos`` fan-out — the exact
+path an operator uses against a live deployment — then heals, proves
+the cluster still commits, waits for convergence, and runs the
+invariant checker (chaos/check.py) over the quiesced stores.
+
+Used by ``tools/chaos.py`` (CLI + CI smoke) and tests/test_chaos.py.
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from minpaxos_tpu.chaos.check import check_cluster
+from minpaxos_tpu.chaos.plan import FaultPlan
+
+#: committed-frontier sample cadence during load (drives the
+#: monotonicity check and the stall detector)
+SAMPLE_S = 0.05
+
+#: slots of post-install frontier advance still attributable to
+#: in-flight traffic when judging "progress stalled"
+STALL_SLACK_SLOTS = 8
+
+
+# --------------------------------------------------------- schedules
+
+def _rng_for(name: str, seed: int) -> np.random.Generator:
+    # crc32, not hash(): schedule identity must survive PYTHONHASHSEED
+    return np.random.default_rng([int(seed), zlib.crc32(name.encode())])
+
+
+def build_schedule(name: str, seed: int, n: int) -> list[tuple]:
+    """Deterministic timed chaos events for one named schedule."""
+    rng = _rng_for(name, seed)
+    sub = int(rng.integers(1 << 30))  # the plan's network-decision seed
+
+    def plan() -> FaultPlan:
+        return FaultPlan(n, seed=sub)
+
+    events: list[tuple] = []
+    if name == "partition_heal":
+        victim = int(rng.integers(1, n))  # a follower: progress continues
+        t0 = 0.2 + float(rng.random()) * 0.2
+        dur = 0.8 + float(rng.random()) * 0.7
+        events = [(t0, "install", plan().isolate(victim).to_dict()),
+                  (t0 + dur, "clear", None)]
+    elif name == "isolated_leader":
+        t0 = 0.25 + float(rng.random()) * 0.15
+        dur = 1.2 + float(rng.random()) * 0.6
+        events = [(t0, "install", plan().isolate(0).to_dict()),
+                  (t0 + dur, "clear", None)]
+    elif name == "flap":
+        # a link pair that flips up and down: the dial/backoff and
+        # retry machinery's worst case
+        a = int(rng.integers(0, n))
+        b = int((a + 1 + rng.integers(0, n - 1)) % n)
+        t = 0.2
+        for _ in range(int(rng.integers(3, 6))):
+            period = 0.2 + float(rng.random()) * 0.2
+            events.append((t, "install",
+                           plan().partition([a], [b]).to_dict()))
+            events.append((t + period, "clear", None))
+            t += 2 * period
+    elif name == "loss_reorder":
+        dur = 2.5 + float(rng.random())
+        events = [(0.0, "install",
+                   plan().all_links(drop=0.10, reorder=4).to_dict()),
+                  (dur, "clear", None)]
+    elif name == "one_way":
+        src = int(rng.integers(0, n))
+        dst = int((src + 1 + rng.integers(0, n - 1)) % n)
+        t0 = 0.2
+        dur = 1.0 + float(rng.random()) * 0.8
+        events = [(t0, "install",
+                   plan().partition([src], [dst], one_way=True).to_dict()),
+                  (t0 + dur, "clear", None)]
+    elif name == "delay_jitter":
+        dur = 2.0 + float(rng.random())
+        events = [(0.0, "install",
+                   plan().all_links(delay_s=0.01,
+                                    jitter_s=0.03).to_dict()),
+                  (dur, "clear", None)]
+    elif name == "dup_storm":
+        dur = 2.0 + float(rng.random())
+        events = [(0.0, "install", plan().all_links(dup=0.30).to_dict()),
+                  (dur, "clear", None)]
+    elif name == "mixed":
+        dur = 2.5 + float(rng.random())
+        events = [(0.0, "install",
+                   plan().all_links(drop=0.05, dup=0.10, delay_s=0.004,
+                                    jitter_s=0.008,
+                                    reorder=3).to_dict()),
+                  (dur, "clear", None)]
+    else:
+        raise ValueError(f"unknown schedule {name!r}")
+    return events
+
+
+SCHEDULES = ("partition_heal", "isolated_leader", "flap", "loss_reorder",
+             "one_way", "delay_jitter", "dup_storm", "mixed")
+
+#: schedules whose fault makes commit progress IMPOSSIBLE while
+#: installed (leader cut off from every quorum): the runner verifies
+#: the stall instead of expecting mid-fault progress
+STALL_SCHEDULES = frozenset({"isolated_leader"})
+
+
+# ---------------------------------------------------------- cluster
+
+class ChaosCluster:
+    """In-process master + N replicas on fresh localhost ports (the
+    tests/test_distributed.py harness shape, importable by tools)."""
+
+    def __init__(self, n: int = 3, store_dir: str | None = None,
+                 durable: bool = False, tick_s: float = 0.001):
+        # late imports: chaos/__init__ must stay importable without JAX
+        from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+        from minpaxos_tpu.runtime.master import Master, register_with_master
+        from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
+        from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
+
+        self.n = n
+        self._tmp = None
+        if store_dir is None:
+            self._tmp = store_dir = tempfile.mkdtemp(prefix="paxchaos-")
+        self.store_dir = store_dir
+        self.mport = free_ports(1)[0]
+        self.maddr = ("127.0.0.1", self.mport)
+        self.addrs = [("127.0.0.1", p) for p in
+                      free_ports(n, sibling_offset=CONTROL_OFFSET)]
+        self.master = Master("127.0.0.1", self.mport, n, ping_s=0.3)
+        self.master.start()
+        self.servers: dict[int, "ReplicaServer"] = {}
+        # a partial boot (a raced port bind, a replica raising in
+        # start) must tear down whatever came up before re-raising:
+        # run_campaign records the run as crashed and keeps going, and
+        # a leaked master + replica threads would degrade every later
+        # run of the campaign
+        try:
+            for host, port in self.addrs:
+                register_with_master(self.maddr, host, port,
+                                     timeout_s=10.0)
+            self.cfg = MinPaxosConfig(
+                n_replicas=n, window=1 << 10, inbox=1024, exec_batch=512,
+                kv_pow2=12, catchup_rows=64, recovery_rows=64)
+            self._mk_flags = lambda: RuntimeFlags(
+                durable=durable, store_dir=store_dir, tick_s=tick_s)
+            for i in range(n):
+                s = ReplicaServer(i, self.addrs, self.cfg,
+                                  self._mk_flags())
+                s.start()
+                self.servers[i] = s
+            # "prepared" is leader state (replica 0 owns the initial
+            # phase 1; followers never set it) — wait for it, loudly
+            deadline = time.monotonic() + 20
+            while not self.servers[0].snapshot["prepared"]:
+                if time.monotonic() > deadline:
+                    # fail loud: driving load into an unprepared
+                    # cluster surfaces later as a bogus chaos failure
+                    # (acked != expected) and sends the operator
+                    # replaying a seed that chases a boot problem
+                    raise TimeoutError(
+                        "leader not prepared within 20 s of boot")
+                time.sleep(0.05)
+        except BaseException:
+            self.stop()
+            raise
+
+    def stores(self) -> dict[int, object]:
+        return {i: s.store for i, s in self.servers.items()}
+
+    def frontiers(self) -> dict[int, int]:
+        return {i: s.snapshot["frontier"]
+                for i, s in self.servers.items()}
+
+    def client(self, backoff_seed: int | None = None):
+        from minpaxos_tpu.runtime.client import Client
+
+        return Client(self.maddr, check=True, backoff_seed=backoff_seed)
+
+    def stop(self) -> None:
+        for s in self.servers.values():
+            s.stop()
+        self.master.stop()
+        if self._tmp is not None:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------- runner
+
+def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
+                 timeout_s: float = 60.0, log=print) -> dict:
+    """One schedule end-to-end; returns a JSON-able result dict whose
+    ``ok`` is the conjunction of load completion, exactly-once replies,
+    real fault injection (> 0), post-heal commit resumption,
+    convergence, and the invariant checker (+ the stall proof for
+    STALL_SCHEDULES). ``ops_n`` sizes the load chunks; total proposed
+    volume is however many chunks fit before the last fault event."""
+    from minpaxos_tpu.runtime.client import gen_workload
+    from minpaxos_tpu.runtime.master import cluster_chaos
+
+    events = build_schedule(name, seed, n)
+    t_wall = time.monotonic()
+    result = {"schedule": name, "seed": seed, "ok": False, "events":
+              [(round(t, 3), op) for t, op, _ in events]}
+    samples: dict[int, list[int]] = {i: [] for i in range(n)}
+    sample_t: list[float] = []
+    stop_sampling = threading.Event()
+    # the cluster is the last thing built OUTSIDE the try: everything
+    # after it (client construction can time out on a busy host) runs
+    # under the finally that stops it — a leaked master + N replica
+    # threads would degrade every later run of the campaign
+    cluster = ChaosCluster(n=n)
+    cli = None
+
+    def sampler():
+        while not stop_sampling.is_set():
+            sample_t.append(time.monotonic())
+            for i, f in cluster.frontiers().items():
+                samples[i].append(f)
+            time.sleep(SAMPLE_S)
+
+    # ONE big workload pool covers the whole schedule: the loader keeps
+    # proposing ``chunk``-sized slices until the LAST chaos event has
+    # fired, so the faults always land on live traffic (a fixed-size
+    # closed loop can finish before the first event on a fast host —
+    # and a fault nobody was talking through injects nothing). Global
+    # cmd_id = pool index, so the linearizability checker replays load
+    # + resume against one reply book without id aliasing.
+    chunk = max(50, min(ops_n, 200))
+    resume_n = 60
+    pool_n = max(ops_n, 200 * chunk)  # never exhausted before stop_load
+    ops, keys, vals = gen_workload(pool_n + resume_n, conflict_pct=20,
+                                   key_range=900, write_pct=70, seed=seed)
+    chunk_stats: list[dict] = []
+    stop_load = threading.Event()
+
+    def load():
+        lo = 0
+        while not stop_load.is_set() and lo + chunk <= pool_n:
+            chunk_stats.append(cli.run_partition(
+                np.arange(lo, lo + chunk), ops, keys, vals, batch=64,
+                timeout_s=timeout_s))
+            lo += chunk
+
+    try:
+        cli = cluster.client(backoff_seed=seed)
+        smp = threading.Thread(target=sampler, daemon=True)
+        smp.start()
+        t0 = time.monotonic()
+        loader = threading.Thread(target=load, daemon=True)
+        loader.start()
+        fault_marks: list[tuple[float, str]] = []
+        for t_off, op, plan in events:
+            delay = t0 + t_off - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            r = cluster_chaos(cluster.maddr, op=op, plan=plan)
+            fault_marks.append((time.monotonic(), op))
+            if not r.get("ok"):
+                result["error"] = f"chaos fan-out failed: {r}"
+                return result
+        time.sleep(0.2)  # let one more chunk straddle the final event
+        stop_load.set()
+        loader.join(timeout=timeout_s + 15)
+        # belt and braces: ALWAYS end healed, whatever the schedule said
+        heal = cluster_chaos(cluster.maddr, op="clear")
+        if not heal.get("ok"):
+            # an unacknowledged clear can leave a shim installed while
+            # the run reports itself healed — and its partial stanzas
+            # would undercount faults_injected below
+            result["error"] = f"final heal fan-out failed: {heal}"
+            return result
+        result["faults_injected"] = sum(
+            r.get("faults_total", 0) for r in heal.get("replicas", []))
+        if loader.is_alive():
+            result["error"] = "load thread never finished"
+            return result
+        # the cluster must RESUME committing after the last heal
+        resume = cli.run_partition(np.arange(pool_n, pool_n + resume_n),
+                                   ops, keys, vals, batch=64,
+                                   timeout_s=30.0)
+        result["resumed_commits"] = resume["acked"] == resume_n
+        # convergence: every replica reaches the same frontier
+        deadline = time.monotonic() + 30
+        converged = False
+        while time.monotonic() < deadline and not converged:
+            fr = cluster.frontiers()
+            converged = len(set(fr.values())) == 1 and min(fr.values()) >= 0
+            if not converged:
+                time.sleep(0.1)
+        result["converged"] = converged
+        stop_sampling.set()
+        smp.join(timeout=2.0)
+        time.sleep(0.3)  # quiesce: no in-flight appends under the checker
+        with cli._lock:
+            replies = dict(cli.replies)
+        report = check_cluster(
+            cluster.stores(), frontier_samples=samples,
+            replies=replies, workload=(ops, keys, vals))
+        result["check"] = report.to_dict()
+        result["acked"] = sum(st["acked"] for st in chunk_stats)
+        result["expected"] = sum(st["sent"] for st in chunk_stats)
+        result["duplicates"] = cli.dup_replies
+        result["client_metrics"] = cli.metrics.counters()
+        if name in STALL_SCHEDULES:
+            result["stall_observed"] = _stalled_during_fault(
+                sample_t, samples, fault_marks)
+        result["ok"] = (report.ok and converged
+                        and result["resumed_commits"]
+                        and result["expected"] > 0
+                        and result["acked"] == result["expected"]
+                        and result["faults_injected"] > 0
+                        and result["duplicates"] == 0
+                        and result.get("stall_observed", True))
+        return result
+    finally:
+        stop_sampling.set()
+        stop_load.set()
+        if cli is not None:
+            cli._done = True
+            cli.close_conn()
+        cluster.stop()
+        result["wall_s"] = round(time.monotonic() - t_wall, 2)
+        if not result["ok"]:
+            log(f"[paxchaos] schedule {name} seed {seed} FAILED — "
+                f"replay with: tools/chaos.py --schedules {name} "
+                f"--seeds {seed}")
+
+
+def _stalled_during_fault(sample_t: list[float],
+                          samples: dict[int, list[int]],
+                          fault_marks: list[tuple[float, str]]) -> bool:
+    """True when commit progress stopped while the fault was installed
+    (after a short settle for in-flight traffic)."""
+    installs = [t for t, op in fault_marks if op == "install"]
+    clears = [t for t, op in fault_marks if op == "clear"]
+    if not installs or not clears:
+        return False
+    lo, hi = installs[0] + 0.4, clears[0]
+    idx = [i for i, t in enumerate(sample_t) if lo <= t <= hi]
+    if len(idx) < 2:
+        return False
+    advances = [seq[idx[-1]] - seq[idx[0]]
+                for seq in samples.values() if len(seq) > idx[-1]]
+    return bool(advances) and max(advances) <= STALL_SLACK_SLOTS
+
+
+def run_campaign(schedules: list[str], seeds: list[int], n: int = 3,
+                 ops_n: int = 400, budget_s: float | None = None,
+                 pairs: list[tuple[int, str]] | None = None,
+                 log=print) -> dict:
+    """Every (schedule, seed) pair — the full product, or an explicit
+    ``pairs`` list [(seed, name), ...] (the CI smoke pairs each fixed
+    seed with one schedule to fit its budget) — one fresh cluster
+    each. The budget clock starts AFTER the first run completes: the
+    first cluster boot pays the one-time jit compile (persistent
+    cache), which is not a campaign property. Returns the aggregate
+    JSON verdict."""
+    results: list[dict] = []
+    ok = True
+    t_budget = None
+    if pairs is None:
+        pairs = [(seed, name) for seed in seeds for name in schedules]
+    for i, (seed, name) in enumerate(pairs):
+        log(f"[paxchaos] schedule {name} seed {seed} ...")
+        try:
+            r = run_schedule(name, seed, n=n, ops_n=ops_n, log=log)
+        except Exception as e:  # paxlint: disable=broad-except
+            # a crashed run must become a seeded failure verdict, not
+            # abort the remaining schedules of a CI campaign
+            r = {"schedule": name, "seed": seed, "ok": False,
+                 "error": f"crashed: {e!r}"}
+        if t_budget is None:
+            t_budget = time.monotonic()  # first run covered jit compile
+        results.append(r)
+        ok = ok and r["ok"]
+        log(f"[paxchaos]   -> {'ok' if r['ok'] else 'FAIL'} "
+            f"acked={r.get('acked')}/{r.get('expected')} "
+            f"faults={r.get('faults_injected')} "
+            f"wall={r.get('wall_s')}s")
+        remaining = len(pairs) - i - 1
+        if (budget_s is not None and remaining
+                and time.monotonic() - t_budget > budget_s):
+            ok = False
+            results.append({"ok": False, "error":
+                            f"budget {budget_s}s exceeded with "
+                            f"{remaining} runs left"})
+            break
+    verdict = {"ok": ok, "schedules": schedules, "seeds": seeds,
+               "runs": results}
+    failed = [r for r in results if not r.get("ok")]
+    if failed:
+        log(f"[paxchaos] CAMPAIGN FAILED ({len(failed)} run(s)); seeds "
+            f"to replay: "
+            f"{sorted({r.get('seed') for r in failed if 'seed' in r})}")
+    return verdict

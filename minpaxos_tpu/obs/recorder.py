@@ -39,8 +39,10 @@ import numpy as np
 
 #: ring-row layout revision; bumped whenever fields change meaning or
 #: position (v1: 12 fields with a single step_us; v2: enqueue_us /
-#: readback_us / overlap_us split, 14 fields)
-SCHEMA_VERSION = 2
+#: readback_us / overlap_us split, 14 fields; v3: trailing
+#: chaos_faults — cumulative paxchaos injected-fault count at this
+#: tick, so Perfetto shows fault bursts against tick regimes)
+SCHEMA_VERSION = 3
 
 # dispatch regimes (runtime/replica.py classifies one per tick:
 # narrow > fused > full; idle-skip never reaches the device)
@@ -56,12 +58,12 @@ KIND_NAMES = ("full", "fused", "narrow", "idle_skip")
 # they never ran and overlap consecutive tick slices in a viewer.
 (F_T_NS, F_KIND, F_K, F_ROWS_IN, F_ROWS_OUT, F_FRONTIER, F_BACKLOG,
  F_DRAIN_US, F_ENQUEUE_US, F_READBACK_US, F_OVERLAP_US, F_PERSIST_US,
- F_DISPATCH_US, F_REPLY_US, F_T_RB_NS) = range(15)
-N_FIELDS = 15
+ F_DISPATCH_US, F_REPLY_US, F_T_RB_NS, F_CHAOS) = range(16)
+N_FIELDS = 16
 FIELD_NAMES = ("t_ns", "kind", "k", "rows_in", "rows_out", "frontier",
                "exec_backlog", "drain_us", "enqueue_us", "readback_us",
                "overlap_us", "persist_us", "dispatch_us", "reply_us",
-               "t_rb_ns")
+               "t_rb_ns", "chaos_faults")
 
 # dispatch-side phases, laid end-to-end ENDING at t_rb_ns (tid 0),
 # and host-side phases ending at t_ns (tid 1 — their own track, so a
@@ -98,16 +100,18 @@ class FlightRecorder:
                rows_out: int, frontier: int, backlog: int, drain_us: int,
                enqueue_us: int, readback_us: int, overlap_us: int,
                persist_us: int, dispatch_us: int, reply_us: int,
-               t_rb_ns: int = 0) -> None:
+               t_rb_ns: int = 0, chaos_faults: int = 0) -> None:
         """``t_ns``: when the tick's host phases completed. ``t_rb_ns``:
         when its readback completed (0 = unknown; to_events then lays
         the dispatch phases contiguously before the host phases, which
-        is exact for serial ticks)."""
+        is exact for serial ticks). ``chaos_faults``: the transport's
+        CUMULATIVE injected-fault total at this tick (0 when paxchaos
+        was never installed — traces without chaos are unchanged)."""
         with self._lock:
             self._buf[self.total % self.capacity] = (
                 t_ns, kind, k, rows_in, rows_out, frontier, backlog,
                 drain_us, enqueue_us, readback_us, overlap_us,
-                persist_us, dispatch_us, reply_us, t_rb_ns)
+                persist_us, dispatch_us, reply_us, t_rb_ns, chaos_faults)
             self.total += 1
 
     def snapshot(self, last: int | None = None) -> np.ndarray:
@@ -181,6 +185,13 @@ class FlightRecorder:
             events.append({"name": "overlap_us", "ph": "C", "ts": t_end,
                            "pid": pid, "tid": 0,
                            "args": {"overlap_us": int(r[F_OVERLAP_US])}})
+            if r[F_CHAOS] > 0:
+                # cumulative injected-fault counter track, emitted only
+                # once chaos has fired: a fault burst shows as a step in
+                # the line right where the tick regimes react to it
+                events.append({"name": "chaos_faults", "ph": "C",
+                               "ts": t_end, "pid": pid, "tid": 0,
+                               "args": {"chaos_faults": int(r[F_CHAOS])}})
         return events
 
 

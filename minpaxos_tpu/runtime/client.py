@@ -24,7 +24,11 @@ import time
 import numpy as np
 
 from minpaxos_tpu.obs.metrics import MetricsRegistry
-from minpaxos_tpu.runtime.master import get_leader, get_replica_list
+from minpaxos_tpu.runtime.master import (
+    backoff_sleeps,
+    get_leader,
+    get_replica_list,
+)
 from minpaxos_tpu.utils.dlog import dlog
 from minpaxos_tpu.wire.codec import FrameWriter, StreamDecoder
 from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
@@ -52,7 +56,8 @@ def gen_workload(n: int, conflict_pct: int = 0, key_range: int = 100000,
 class Client:
     """One TCP connection to one replica + reply collection thread."""
 
-    def __init__(self, maddr: tuple[str, int], check: bool = False):
+    def __init__(self, maddr: tuple[str, int], check: bool = False,
+                 backoff_seed: int | None = None):
         self.maddr = maddr
         self.check = check
         self.nodes = get_replica_list(maddr)
@@ -72,6 +77,21 @@ class Client:
         self._c_failovers = self.metrics.counter(
             "failovers", "connection re-routes (leader hint / master "
             "/ scan)")
+        self._c_connect_attempts = self.metrics.counter(
+            "connect_attempts", "individual replica dials tried during "
+            "failovers (>> failovers means the cluster was hard to "
+            "reach)")
+        self._c_backoff_sleeps = self.metrics.counter(
+            "backoff_sleeps", "failover rounds that found NO reachable "
+            "replica and slept a jittered exponential backoff")
+        # failover backoff (seeded): when no replica answers, sleeps
+        # grow 50 ms -> 2 s with U[0.5, 1.0] jitter instead of the old
+        # fixed 0.5 s — a fleet of chaos-campaign clients redialing a
+        # dead cluster must decorrelate, not arrive as one synchronized
+        # storm on revival. An explicit seed makes a campaign's redial
+        # pattern part of its reproducible schedule.
+        self._backoff_rng = np.random.default_rng(backoff_seed)
+        self._backoff = None  # live generator while a streak lasts
         self.leader_hint = -1
         self._lock = threading.Lock()
         self._got = threading.Condition(self._lock)
@@ -279,14 +299,20 @@ class Client:
             pass
         candidates.extend(r for r in range(len(self.nodes)))
         for rid in candidates:
+            self._c_connect_attempts.inc()
             try:
                 self.connect(rid)
                 self.leader = rid
+                self._backoff = None  # reachable again: reset the streak
                 dlog(f"client: failed over to replica {rid}")
                 return
             except OSError:
                 continue
-        time.sleep(0.5)
+        # nothing reachable: jittered exponential backoff (see __init__)
+        if self._backoff is None:
+            self._backoff = backoff_sleeps(0.05, 2.0, self._backoff_rng)
+        self._c_backoff_sleeps.inc()
+        time.sleep(next(self._backoff))
 
 
 class MultiClient:

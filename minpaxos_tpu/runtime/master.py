@@ -104,11 +104,11 @@ class Master:
 
     def _handle(self, req: dict) -> dict:
         m = req.get("m")
-        if m in ("stats", "trace"):
-            # paxmon fan-out verbs: these poll every replica's control
-            # socket, so they must NOT run under the membership lock —
-            # one slow replica's 2 s control timeout would stall the
-            # ping loop and every registration behind it
+        if m in ("stats", "trace", "chaos"):
+            # paxmon/paxchaos fan-out verbs: these poll every replica's
+            # control socket, so they must NOT run under the membership
+            # lock — one slow replica's 2 s control timeout would stall
+            # the ping loop and every registration behind it
             return self._observe(m, req)
         with self._lock:
             if m == "register":
@@ -141,18 +141,26 @@ class Master:
     # -- paxmon: cluster-wide STATS / TRACE fan-out --
 
     def _observe(self, m: str, req: dict) -> dict:
-        """Forward the replica-level ``stats``/``trace`` control verb
-        to every registered replica and merge the answers: paxtop and
-        the bench artifacts get the whole cluster in one RPC. A dead
-        replica contributes an error stanza, never a fan-out failure.
-        Membership is copied under the lock; the per-replica RPCs run
-        outside it (they block up to their timeout)."""
+        """Forward the replica-level ``stats``/``trace``/``chaos``
+        control verb to every registered replica and merge the answers:
+        paxtop and the bench artifacts get the whole cluster in one
+        RPC, and a chaos campaign flips a cluster-wide fault plan the
+        same way (every replica installs the SAME plan and enforces
+        its own slice — chaos/plan.py). A dead replica contributes an
+        error stanza, never a fan-out failure. Membership is copied
+        under the lock; the per-replica RPCs run outside it (they
+        block up to their timeout)."""
         with self._lock:
             nodes = list(enumerate(self.nodes))
             leader = self.leader
             alive = list(self.alive)
-        sub = {"m": m} if m == "stats" else \
-            {"m": "trace", "last": req.get("last")}
+        if m == "stats":
+            sub = {"m": m}
+        elif m == "trace":
+            sub = {"m": "trace", "last": req.get("last")}
+        else:
+            sub = {"m": "chaos", "op": req.get("op", "status"),
+                   "plan": req.get("plan")}
         timeout = 5.0 if m == "trace" else 2.0
         # one poller thread per replica: dead replicas cost
         # max(timeout), not sum — a mostly-down cluster must still
@@ -186,6 +194,18 @@ class Master:
             replicas.append(r)
         out = {"ok": True, "leader": leader, "alive": alive,
                "n": self.n, "replicas": replicas}
+        if m == "chaos" and sub["op"] in ("install", "clear"):
+            # a PARTIAL install/clear is the dangerous case (half the
+            # cluster faulted, half clean, and the campaign thinks it
+            # healed): those fan-outs are only ok if every replica
+            # acknowledged — and "every" means all n, not just the
+            # currently-registered subset (a replica registering a
+            # moment later would join with no plan installed). A
+            # read-only "status" keeps the dead-replica-tolerant
+            # contract above — a crashed replica contributes its
+            # error stanza, not a fan-out failure
+            out["ok"] = (len(replicas) == self.n
+                         and all(bool(r.get("ok")) for r in replicas))
         if m == "trace":
             # one merged Chrome trace object: each replica's events
             # already carry pid=replica id, and monotonic timestamps
@@ -273,10 +293,30 @@ class Master:
                     self.leader = new_leader
 
 
+def backoff_sleeps(base_s: float, cap_s: float, rng) -> "Iterator[float]":
+    """Bounded exponential backoff with jitter: base*2^i capped at
+    ``cap_s``, each scaled by a U[0.5, 1.0] draw from ``rng``. Seeding
+    ``rng`` differently per caller decorrelates redials — N replicas
+    (or a client fleet) hammering a dead master must not fall into
+    lockstep and arrive as one synchronized storm when it revives."""
+    i = 0
+    while True:
+        yield min(base_s * (2 ** i), cap_s) * (0.5 + 0.5 * float(rng.random()))
+        i += 1
+
+
 def register_with_master(maddr: tuple[str, int], my_host: str, my_port: int,
-                         retry_s: float = 0.5, timeout_s: float = 60.0) -> int:
+                         retry_s: float = 0.25, timeout_s: float = 60.0,
+                         seed: int | None = None) -> int:
     """Server-side registration retry loop (server.go:91-108). Returns
-    the assigned replica id once the full membership is known."""
+    the assigned replica id once the full membership is known. Retries
+    back off exponentially (jittered, seeded by ``seed`` or the
+    caller's port so concurrent registrants decorrelate) instead of
+    the old fixed 0.5 s cadence."""
+    import numpy as _np
+
+    rng = _np.random.default_rng(my_port if seed is None else seed)
+    sleeps = backoff_sleeps(retry_s, 3.0, rng)
     deadline = time.monotonic() + timeout_s
     rid = None
     while time.monotonic() < deadline:
@@ -287,9 +327,13 @@ def register_with_master(maddr: tuple[str, int], my_host: str, my_port: int,
                 rid = int(resp["id"])
                 if resp.get("ready"):
                     return rid
+            # reachable master, membership not complete yet: this is a
+            # readiness poll, not a failure — base cadence, streak reset
+            sleeps = backoff_sleeps(retry_s, 3.0, rng)
+            sleep_s = retry_s
         except (OSError, json.JSONDecodeError):
-            pass
-        time.sleep(retry_s)
+            sleep_s = next(sleeps)
+        time.sleep(min(sleep_s, max(deadline - time.monotonic(), 0.05)))
     if rid is not None:
         return rid
     raise TimeoutError("could not register with master")
@@ -313,6 +357,18 @@ def cluster_stats(maddr: tuple[str, int], timeout_s: float = 15.0) -> dict:
     """One-shot cluster metrics snapshot via the master's ``stats``
     fan-out (paxtop's poll; bench artifacts embed the same shape)."""
     return _rpc(maddr, {"m": "stats"}, timeout=timeout_s)
+
+
+def cluster_chaos(maddr: tuple[str, int], op: str = "status",
+                  plan: dict | None = None,
+                  timeout_s: float = 15.0) -> dict:
+    """paxchaos fan-out: install / clear / query a fault plan on every
+    replica of a LIVE cluster through the master (``plan`` is a
+    ``FaultPlan.to_dict()``). ``ok`` is True only when EVERY replica
+    acknowledged — a partial install must fail loudly, not leave half
+    the cluster faulted behind a 'healed' campaign."""
+    return _rpc(maddr, {"m": "chaos", "op": op, "plan": plan},
+                timeout=timeout_s)
 
 
 def cluster_trace(maddr: tuple[str, int], last: int | None = None,

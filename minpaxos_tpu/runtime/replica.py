@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from minpaxos_tpu.chaos import ChaosShim, FaultPlan
 from minpaxos_tpu.models.minpaxos import (
     ACCEPTED,
     COMMITTED,
@@ -335,6 +336,11 @@ class ReplicaServer:
         self.store = StableStore(
             f"{self.flags.store_dir}/stable-store-replica{me}",
             sync=self.flags.durable)
+        # CRC-rejected log records (stable.py replay): nonzero after a
+        # recovery that skipped flipped-byte records — the holes self-
+        # heal via peers, but the operator must see the disk went bad
+        m.fn_gauge("store_corrupt_records",
+                   lambda: self.store.corrupt_records)
         self.inbox = batches.ColumnBuffer(self.cfg.inbox)
         # reply bookkeeping: (conn_id, cmd_id) -> reply kind to send
         self._pending: dict[tuple[int, int], MsgKind] = {}
@@ -611,6 +617,13 @@ class ReplicaServer:
                     resp = {"ok": True, "id": self.me,
                             "recorder": self.recorder is not None,
                             "events": events}
+                elif m == "chaos":
+                    # paxchaos verb: install/clear/status a fault plan
+                    # on the LIVE transport. Installing is an attribute
+                    # swap the reader threads observe per frame, so a
+                    # partition can be flipped mid-workload; status
+                    # reports per-kind injected-fault tallies.
+                    resp = self._chaos_verb(req)
                 elif m == "be_the_leader":
                     self.queue.put((CONTROL, 0, "be_the_leader", None))
                     resp = {"ok": True}
@@ -625,6 +638,28 @@ class ReplicaServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _chaos_verb(self, req: dict) -> dict:
+        op = req.get("op", "status")
+        try:
+            if op == "install":
+                plan = FaultPlan.from_dict(req["plan"])
+                if plan.n != self.cfg.n_replicas:
+                    raise ValueError(
+                        f"plan sized for {plan.n} replicas, cluster "
+                        f"has {self.cfg.n_replicas}")
+                self.transport.set_chaos(
+                    ChaosShim(self.me, plan, self.queue))
+            elif op == "clear":
+                self.transport.set_chaos(None)
+            elif op != "status":
+                raise ValueError(f"unknown chaos op {op!r}")
+        except (KeyError, TypeError, ValueError) as e:
+            return {"ok": False, "id": self.me, "error": repr(e)[:200]}
+        ch = self.transport.chaos
+        return {"ok": True, "id": self.me, "installed": ch is not None,
+                "faults": ch.counts() if ch is not None else {},
+                "faults_total": self.transport.chaos_faults_total()}
 
     # ---------------- beacons ----------------
 
@@ -758,7 +793,8 @@ class ReplicaServer:
                 self.recorder.record(
                     monotonic_ns(), KIND_IDLE_SKIP, 0, 0, 0,
                     self.snapshot["frontier"], 0,
-                    int(self._drain_work_s * 1e6), 0, 0, 0, 0, 0, 0)
+                    int(self._drain_work_s * 1e6), 0, 0, 0, 0, 0, 0,
+                    chaos_faults=self.transport.chaos_faults_total())
             # skipping IS being idle: without this the next poll waits
             # only tick_s (2 ms) and a quiet replica spins the skip
             # check at 500 Hz instead of idle_s pacing
@@ -1308,7 +1344,8 @@ class ReplicaServer:
                 rec.frontier, rec.backlog, rec.drain_us, rec.enqueue_us,
                 rec.readback_us, int(host_s * 1e6) if overlapped else 0,
                 int(persist_s * 1e6), int(dispatch_s * 1e6),
-                int(reply_s * 1e6), rec.t_rb_ns)
+                int(reply_s * 1e6), rec.t_rb_ns,
+                chaos_faults=self.transport.chaos_faults_total())
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
 

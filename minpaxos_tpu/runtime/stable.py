@@ -24,10 +24,21 @@ from __future__ import annotations
 
 import os
 import struct
+import sys
+import zlib
 
 import numpy as np
 
-MAGIC = b"MPXL0001"
+#: v1 framing: [type u8][len u32][payload] — no integrity check; a
+#: flipped payload byte replayed as protocol state (silent divergence)
+MAGIC_V1 = b"MPXL0001"
+#: v2 framing (current): [type u8][len u32][crc u32][payload], crc =
+#: crc32(header || payload). Replay SKIPS records whose CRC fails
+#: (counted + warned) instead of ingesting flipped bytes; the holes
+#: report not-committed, so peers' re-sends self-heal them. The magic
+#: picks the framing per file: v1 files replay — and keep appending —
+#: in v1 form, so an old log stays self-consistent.
+MAGIC = b"MPXL0002"
 
 _COMMITTED = 4  # models/minpaxos.py status enum (kept import-free here)
 
@@ -41,12 +52,18 @@ _FRONTIER = struct.Struct("<i")  # committed_upto
 REC_SLOTS = 1  # payload: u32 count + count*SLOT_DT
 REC_FRONTIER = 2  # payload: i32
 _HDR = struct.Struct("<BI")  # record type, payload bytes
+_CRC = struct.Struct("<I")  # v2 framing: crc32(header || payload)
+
+#: per-file cap on individually warned corrupt records (the tally
+#: keeps counting; the terminal must not scroll a rotted disk forever)
+_CORRUPT_WARN_CAP = 5
 
 
 class StableStore:
     """Durable redo log for one replica.
 
-    File layout: MAGIC, then records of [type u8][len u32][payload].
+    File layout: MAGIC, then records of [type u8][len u32][crc u32]
+    [payload] (the crc field only under the v2 magic — see MAGIC_V1).
     ``sync=False`` trades durability for speed (the reference's
     non--durable mode skips persistence entirely).
     """
@@ -74,9 +91,22 @@ class StableStore:
         # or sort the whole mirror
         self._contig = -1
         self.frontier = -1
+        # CRC-rejected records seen by _replay (surfaced as a paxmon
+        # fn-gauge by the replica runtime)
+        self.corrupt_records = 0
+        # whether this FILE carries v2 per-record CRCs (decided by its
+        # magic on replay; new files are always v2)
+        self.crc_framing = True
         if existed:
             self._replay()
-            self._f = open(path, "ab")
+            # truncate the torn tail before appending: new records
+            # written AFTER leftover partial-record bytes would be
+            # swallowed into that record's length field on the next
+            # replay (v1 could then silently mis-parse; v2 would skip
+            # them as CRC garbage) — cut to the last record boundary
+            self._f = open(path, "r+b")
+            self._f.seek(self._parsed_end)
+            self._f.truncate()
         else:
             self._f = open(path, "wb")
             self._f.write(MAGIC)
@@ -150,17 +180,21 @@ class StableStore:
         rec["inst"], rec["ballot"], rec["status"] = inst, ballot, status
         rec["op"], rec["key"], rec["val"] = op, key, val
         rec["cmd_id"], rec["client_id"] = cmd_id, client_id
-        payload = rec.tobytes()
-        self._f.write(_HDR.pack(REC_SLOTS, len(payload)))
-        self._f.write(payload)
+        self._write_record(REC_SLOTS, rec.tobytes())
         self._update_mirror(rec)
+
+    def _write_record(self, rtype: int, payload: bytes) -> None:
+        hdr = _HDR.pack(rtype, len(payload))
+        self._f.write(hdr)
+        if self.crc_framing:
+            self._f.write(_CRC.pack(zlib.crc32(payload, zlib.crc32(hdr))))
+        self._f.write(payload)
 
     def append_frontier(self, committed_upto: int) -> None:
         if committed_upto <= self.frontier:
             return
         self.frontier = committed_upto
-        self._f.write(_HDR.pack(REC_FRONTIER, _FRONTIER.size))
-        self._f.write(_FRONTIER.pack(committed_upto))
+        self._write_record(REC_FRONTIER, _FRONTIER.pack(committed_upto))
         # entries at/below min(contig, frontier) are covered by the
         # is_committed() prefix check — prune so the set stays small in
         # steady state instead of growing for the process lifetime
@@ -184,25 +218,98 @@ class StableStore:
 
     # -- read --
 
+    @staticmethod
+    def _resync(data: bytes, start: int) -> int | None:
+        """Scan past a corrupt length field (v2 framing only) for the
+        next whole-record boundary: an offset qualifies iff its header
+        is plausible AND its CRC validates, so a false positive is a
+        2^-32 coincidence. Runs only on corruption, never on the clean
+        replay path. Returns None when no record follows — i.e. the
+        unparseable region really is a torn tail."""
+        end = len(data)
+        off = start + 1
+        while off + _HDR.size + _CRC.size <= end:
+            rtype, plen = _HDR.unpack_from(data, off)
+            body = off + _HDR.size + _CRC.size
+            if rtype in (REC_SLOTS, REC_FRONTIER) and body + plen <= end:
+                (crc,) = _CRC.unpack_from(data, off + _HDR.size)
+                want = zlib.crc32(data[body: body + plen],
+                                  zlib.crc32(data[off: off + _HDR.size]))
+                if crc == want:
+                    return off
+            off += 1
+        return None
+
     def _replay(self) -> None:
         with open(self.path, "rb") as f:
             data = f.read()
-        if data[: len(MAGIC)] != MAGIC:
+        magic = data[: len(MAGIC)]
+        if magic == MAGIC:
+            crc_framing = True
+        elif magic == MAGIC_V1:
+            crc_framing = False  # pre-CRC log: replay + append as v1
+        else:
             raise ValueError(f"{self.path}: bad magic")
+        self.crc_framing = crc_framing
         pos = len(MAGIC)
+        self._parsed_end = pos  # last whole-record boundary reached
         while pos + _HDR.size <= len(data):
             rtype, plen = _HDR.unpack_from(data, pos)
-            pos += _HDR.size
-            if pos + plen > len(data):
-                break  # torn tail write (crash mid-append): ignore
+            body = pos + _HDR.size + (_CRC.size if crc_framing else 0)
+            if body + plen > len(data):
+                # the declared record runs past EOF. A genuine torn
+                # tail (crash mid-append) looks exactly like a flipped
+                # LENGTH byte mid-file — but __init__ TRUNCATES at
+                # _parsed_end, so treating the latter as a tail would
+                # destroy every valid record after it. Resync on the
+                # next CRC-valid record boundary: found ⇒ mid-file
+                # corruption, skip the garbage; not found ⇒ real tail
+                nxt = self._resync(data, pos) if crc_framing else None
+                if nxt is None:
+                    break  # torn tail write (crash mid-append): ignore
+                self.corrupt_records += 1
+                if self.corrupt_records <= _CORRUPT_WARN_CAP:
+                    print(f"{self.path}: corrupt length field at byte "
+                          f"{pos} — resynced at {nxt}, "
+                          f"{nxt - pos} B skipped; holes self-heal "
+                          f"from peers", file=sys.stderr, flush=True)
+                pos = nxt
+                self._parsed_end = pos
+                continue
+            if crc_framing:
+                (crc,) = _CRC.unpack_from(data, pos + _HDR.size)
+                want = zlib.crc32(data[body: body + plen],
+                                  zlib.crc32(data[pos: pos + _HDR.size]))
+                if crc != want:
+                    # flipped bytes: SKIP the record instead of
+                    # ingesting it — the resulting slot holes report
+                    # not-committed (is_committed) and peers' re-sends
+                    # heal them. A corrupted in-file length field
+                    # desyncs the skip and cascades CRC failures until
+                    # a garbage header points past EOF, where the
+                    # resync above recovers the remaining records.
+                    self.corrupt_records += 1
+                    if self.corrupt_records <= _CORRUPT_WARN_CAP:
+                        print(f"{self.path}: CRC mismatch at byte "
+                              f"{pos} (record type {rtype}, "
+                              f"{plen} B) — record skipped; holes "
+                              f"self-heal from peers",
+                              file=sys.stderr, flush=True)
+                    pos = body + plen
+                    self._parsed_end = pos
+                    continue
             if rtype == REC_SLOTS and plen % SLOT_DT.itemsize == 0:
                 n = plen // SLOT_DT.itemsize
                 if n:
-                    self._update_mirror(np.frombuffer(data, SLOT_DT, n, pos))
+                    self._update_mirror(np.frombuffer(data, SLOT_DT, n, body))
             elif rtype == REC_FRONTIER and plen == _FRONTIER.size:
-                (fr,) = _FRONTIER.unpack_from(data, pos)
+                (fr,) = _FRONTIER.unpack_from(data, body)
                 self.frontier = max(self.frontier, fr)
-            pos += plen
+            pos = body + plen
+            self._parsed_end = pos
+        if self.corrupt_records > _CORRUPT_WARN_CAP:
+            print(f"{self.path}: {self.corrupt_records} corrupt records "
+                  f"skipped in total", file=sys.stderr, flush=True)
         covered = min(self._contig, self.frontier)
         self.committed = {i for i in self.committed if i > covered}
 

@@ -67,6 +67,24 @@ class Transport:
         # delta-based rates negative. Guarded by _lock.
         self._closed_tallies = {"frames_in": 0, "rows_in": 0,
                                 "bytes_in": 0, "frames_out": 0}
+        # paxchaos shim (chaos/shim.py): consulted per peer frame in
+        # send_peer/_read_loop when installed. The disabled path is ONE
+        # attribute load + is-None test per frame — no allocation, no
+        # branch into chaos code. _chaos_retired carries fault totals
+        # of replaced shims so the fn-gauge stays monotonic across
+        # install/heal cycles (same contract as _closed_tallies).
+        self.chaos = None
+        self._chaos_retired = 0
+        # per-peer dial suppression state: a refused dial doubles the
+        # peer's suppression window instead of re-timing out every
+        # 0.5 s — a flapping or partitioned peer must not price a
+        # connect timeout into every dispatch. Written by the protocol
+        # thread (refusal) AND the accept thread (inbound-install
+        # reset), both under self._lock; dial_peer's lone window read
+        # stays lock-free (a stale read costs one extra suppression)
+        self._dial_fails: dict[int, int] = {}
+        self._dial_window: dict[int, float] = {}
+        self._dial_tallies = {"ok": 0, "refused": 0, "suppressed": 0}
         if metrics is not None:
             # wire visibility in the owner's registry: evaluated at
             # snapshot time (obs/metrics.py fn_gauge), so the per-frame
@@ -76,6 +94,13 @@ class Transport:
             for attr in ("frames_in", "rows_in", "bytes_in", "frames_out"):
                 metrics.fn_gauge(f"net_{attr}",
                                  lambda a=attr: self._net_total(a))
+            # dial outcomes: 'suppressed' (backoff window) vs 'refused'
+            # (real connect failure) are distinct signals — peer_alive
+            # false + dials_suppressed rising means backoff, not churn
+            for k in ("ok", "refused", "suppressed"):
+                metrics.fn_gauge(f"dials_{k}",
+                                 lambda k=k: self._dial_tallies[k])
+            metrics.fn_gauge("chaos_injected", self.chaos_faults_total)
         # Client connection ids are globally unique across replicas
         # (replica id in the high bits): command provenance travels
         # through the log as (client_id, cmd_id), and a follower
@@ -100,6 +125,46 @@ class Transport:
             total = self._closed_tallies[attr]
             conns = list(self.peers.values()) + list(self.clients.values())
         return total + sum(getattr(c, attr) for c in conns)
+
+    # -- paxchaos (chaos/shim.py) --
+
+    def set_chaos(self, shim) -> None:
+        """Install (or, with None, heal) the fault-injection shim.
+        Called from the control thread; readers grab one reference per
+        frame, so the attribute swap is the whole synchronization for
+        the DATA path. The tally handoff needs more care: stop the old
+        shim FIRST (no tallies advance past its stopped flag), then
+        fold its total into the retired carry and swap in the new shim
+        under the lock chaos_faults_total shares — folding after the
+        swap let a tick-thread read see the counter step down to zero
+        and back (a Perfetto counter track going negative). An ingest
+        already past the stopped check can still tally after the fold —
+        the same bounded monitoring undercount _closed_tallies accepts."""
+        if shim is not None:
+            from minpaxos_tpu.chaos import shim as _chaos_shim
+
+            assert _chaos_shim.FROM_PEER == FROM_PEER
+        old = self.chaos
+        if old is not None:
+            old.stop()  # outside the lock: stop delivers held frames
+        with self._lock:
+            if old is not None:
+                self._chaos_retired += old.faults_total()
+            self.chaos = shim
+
+    def chaos_faults_total(self) -> int:
+        ch = self.chaos
+        if ch is None:
+            # lock-free fast path: the recorder calls this every tick,
+            # and with no shim installed it must not price a lock
+            # acquire into the tick floor. _chaos_retired only changes
+            # inside set_chaos AFTER the fold, so a None read here
+            # always sees the retired total already folded — monotonic
+            return self._chaos_retired
+        with self._lock:
+            ch = self.chaos
+            total = self._chaos_retired
+        return total if ch is None else total + ch.faults_total()
 
     # -- lifecycle --
 
@@ -130,24 +195,53 @@ class Transport:
         for q in range(self.me):
             self.dial_peer(q)
 
+    #: dial backoff ceiling: a peer refusing for a while is re-tried at
+    #: most this often; any successful connect (either direction)
+    #: resets its window to the base rate
+    DIAL_BACKOFF_CAP_S = 5.0
+
     def dial_peer(self, q: int, rate_limit_s: float = 0.5) -> bool:
-        """(Re)connect to peer q; rate-limited so a dead peer doesn't
-        stall the protocol tick with back-to-back connect timeouts."""
+        """(Re)connect to peer q. The suppression window is PER PEER
+        and doubles on every refused dial (up to DIAL_BACKOFF_CAP_S):
+        the old per-call wall-clock limit let a flapping link re-pay a
+        full connect timeout every 0.5 s on the protocol thread. The
+        dials_{ok,refused,suppressed} tallies make 'peer dead' vs
+        'dial suppressed by backoff' distinguishable in stats."""
         now = time.monotonic()
-        if now - self._last_dial.get(q, -1e9) < rate_limit_s:
+        window = self._dial_window.get(q, rate_limit_s)
+        if now - self._last_dial.get(q, -1e9) < window:
+            self._dial_tallies["suppressed"] += 1
             return False
         self._last_dial[q] = now
+        prev = self.peers.get(q)
         try:
             sock = socket.create_connection(self.addrs[q], timeout=1.0)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.sendall(bytes([int(MsgKind.HANDSHAKE_PEER), self.me]))
         except OSError:
+            with self._lock:
+                # an inbound handshake can land (accept thread) while
+                # this connect was timing out; growing the window then
+                # would suppress the first redial after that live conn
+                # later drops — only record the refusal if no install
+                # raced us
+                if self.peers.get(q) is prev:
+                    fails = self._dial_fails.get(q, 0) + 1
+                    self._dial_fails[q] = fails
+                    self._dial_window[q] = min(
+                        rate_limit_s * (2 ** fails),
+                        self.DIAL_BACKOFF_CAP_S)
+            self._dial_tallies["refused"] += 1
             return False
+        self._dial_tallies["ok"] += 1
         self._install_peer(q, sock)
         return True
 
     def stop(self) -> None:
         self._stop.set()
+        ch = self.chaos
+        if ch is not None:
+            ch.stop(flush=False)  # shutting down: nothing to heal into
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -214,6 +308,12 @@ class Transport:
                 for attr in self._closed_tallies:
                     self._closed_tallies[attr] += getattr(old, attr)
             self.peers[q] = conn = _Conn(sock)
+            # live connection (either direction) resets q's dial
+            # backoff — under the lock, paired with dial_peer's
+            # refused-path write, so a racing refusal can't re-grow
+            # the window after this conn landed
+            self._dial_fails.pop(q, None)
+            self._dial_window.pop(q, None)
         if old is not None:
             try:
                 old.sock.close()
@@ -241,7 +341,13 @@ class Transport:
             conn.frames_in += len(frames)
             for kind, rows in frames:
                 conn.rows_in += len(rows)
-                self.queue.put((src_kind, conn_id, kind, rows))
+                # paxchaos inbound gate, peer links only: the disabled
+                # path is one attribute load + is-test per frame
+                ch = self.chaos
+                if ch is not None and src_kind == FROM_PEER:
+                    ch.ingest(conn_id, kind, rows)
+                else:
+                    self.queue.put((src_kind, conn_id, kind, rows))
             if dec.error is not None:
                 break
         conn.alive = False
@@ -258,6 +364,13 @@ class Transport:
         conn = self.peers.get(q)
         if conn is None or not conn.alive:
             return False
+        # paxchaos outbound gate: a blocked link blackholes silently —
+        # returning True models an asymmetric partition (the TCP
+        # connection is up, the network eats the bytes) and keeps the
+        # caller from spinning redials at a peer that IS alive
+        ch = self.chaos
+        if ch is not None and not ch.allow_send(q):
+            return True
         try:
             conn.writer.write(kind, rows)
             conn.frames_out += 1

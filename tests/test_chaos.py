@@ -1,0 +1,432 @@
+"""paxchaos: fault-plan/shim determinism, byte transparency, store CRC
+recovery, backoff satellites, and the partition-the-leader integration
+scenario (ROBUSTNESS.md).
+"""
+
+import queue
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.chaos import ChaosShim, FaultPlan
+from minpaxos_tpu.chaos.campaign import SCHEDULES, build_schedule
+from minpaxos_tpu.runtime.stable import (
+    MAGIC_V1,
+    REC_FRONTIER,
+    REC_SLOTS,
+    SLOT_DT,
+    StableStore,
+)
+from minpaxos_tpu.runtime.transport import FROM_PEER, Transport
+from minpaxos_tpu.utils.netutil import free_ports
+from minpaxos_tpu.wire.messages import MsgKind, make_batch
+
+
+# ------------------------------------------------------------- plan
+
+def test_fault_plan_roundtrip_and_validation():
+    p = (FaultPlan(3, seed=7).isolate(0)
+         .set_link(1, 2, drop=0.1, reorder=4, delay_s=0.01, jitter_s=0.02))
+    d = p.to_dict()
+    assert FaultPlan.from_dict(d).to_dict() == d
+    assert not p.is_noop() and FaultPlan(3).is_noop()
+    with pytest.raises(ValueError):
+        FaultPlan(3).set_link(0, 0, block=True)  # self-link
+    with pytest.raises(ValueError):
+        FaultPlan(3).set_link(0, 3, block=True)  # out of range
+    with pytest.raises(ValueError):
+        FaultPlan(3).set_link(0, 1, drop=1.5)  # not a probability
+    with pytest.raises(ValueError):
+        FaultPlan(3).set_link(0, 1, delay_s=100.0)  # over MAX_DELAY_S
+    # one-way partition blocks exactly one direction
+    ow = FaultPlan(3).partition([1], [0], one_way=True)
+    assert ow.link(1, 0).block and ow.link(0, 1) is None
+
+
+def test_schedule_determinism_pinned():
+    """Acceptance pin: the same (schedule, seed) reproduces the
+    IDENTICAL fault schedule — event times, ops, and the plan dicts
+    (whose seed drives every per-link network decision)."""
+    for name in SCHEDULES:
+        a = build_schedule(name, 1234, 3)
+        b = build_schedule(name, 1234, 3)
+        assert a == b, name
+        assert a != build_schedule(name, 1235, 3), name
+        assert a, f"{name}: empty schedule"
+        times = [t for t, _, _ in a]
+        assert times == sorted(times), name
+
+
+# ------------------------------------------------------------- shim
+
+def _drain_queue(q):
+    out = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue.Empty:
+            return out
+
+
+def test_shim_seed_determinism():
+    """Same plan + seed => identical drop/dup/delay decisions per
+    link, independent of wall clock; a different seed differs."""
+    def run(seed):
+        q = queue.Queue()
+        plan = FaultPlan(3, seed=seed).set_link(
+            1, 0, drop=0.3, dup=0.2, delay_s=0.0)
+        sh = ChaosShim(0, plan, q)
+        decisions = [sh._in[1].decide() for _ in range(300)]
+        sh.stop()
+        return decisions
+
+    assert run(11) == run(11)
+    assert run(11) != run(12)
+    # and end-to-end through ingest: the delivered subset matches
+    def deliver(seed):
+        q = queue.Queue()
+        sh = ChaosShim(0, FaultPlan(2, seed=seed).set_link(1, 0, drop=0.4),
+                       q)
+        for i in range(200):
+            sh.ingest(1, int(MsgKind.ACCEPT), i)
+        sh.stop()
+        return [item[3] for item in _drain_queue(q)]
+
+    assert deliver(5) == deliver(5)
+    assert deliver(5) != deliver(6)
+
+
+def test_shim_reorder_deterministic_permutation():
+    def run(seed):
+        q = queue.Queue()
+        sh = ChaosShim(0, FaultPlan(2, seed=seed).set_link(1, 0, reorder=4),
+                       q)
+        for i in range(12):  # three full windows: no time-flush path
+            sh.ingest(1, int(MsgKind.ACCEPT), i)
+        sh.stop()
+        return [item[3] for item in _drain_queue(q)]
+
+    got = run(3)
+    assert sorted(got) == list(range(12))
+    assert got != list(range(12)), "permutation never fired"
+    assert got == run(3)
+    counts = ChaosShim(0, FaultPlan(2, seed=3), queue.Queue()).counts()
+    assert set(counts) == {"blocked_in", "dropped", "delayed",
+                           "duplicated", "reordered", "blocked_out"}
+
+
+def test_shim_duplicate_and_delay():
+    q = queue.Queue()
+    sh = ChaosShim(0, FaultPlan(2, seed=9).set_link(1, 0, dup=1.0), q)
+    for i in range(5):
+        sh.ingest(1, int(MsgKind.ACCEPT), i)
+    got = [item[3] for item in _drain_queue(q)]
+    assert got == [0, 0, 1, 1, 2, 2, 3, 3, 4, 4]
+    assert sh.counts()["duplicated"] == 5
+    sh.stop()
+    # a delayed frame arrives later, via the pump thread
+    q2 = queue.Queue()
+    sh2 = ChaosShim(0, FaultPlan(2, seed=9).set_link(1, 0, delay_s=0.04),
+                    q2)
+    t0 = time.monotonic()
+    sh2.ingest(1, int(MsgKind.ACCEPT), "x")
+    item = q2.get(timeout=2.0)
+    assert item == (FROM_PEER, 1, int(MsgKind.ACCEPT), "x")
+    assert time.monotonic() - t0 >= 0.03
+    assert sh2.counts()["delayed"] == 1
+    sh2.stop()
+
+
+def _mk_transport_pair():
+    addrs = [("127.0.0.1", p) for p in free_ports(2)]
+    ta, tb = Transport(0, addrs), Transport(1, addrs)
+    ta.listen()
+    tb.listen()
+    tb.connect_peers()  # 1 dials 0
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if ta.peer_alive(1) and tb.peer_alive(0):
+            return ta, tb
+        time.sleep(0.02)
+    raise TimeoutError("transport pair never meshed")
+
+
+def test_disabled_shim_is_byte_transparent():
+    """No shim, a no-op-plan shim, and a cleared shim must all deliver
+    the exact bytes the baseline path delivers."""
+    ta, tb = _mk_transport_pair()
+    try:
+        frame = make_batch(MsgKind.ACCEPT, leader_id=1,
+                           inst=np.arange(4), ballot=17, op=1,
+                           key=np.arange(4) * 3, val=np.arange(4) * 7,
+                           cmd_id=np.arange(4), client_id=0,
+                           last_committed=-1)
+
+        def send_and_recv():
+            assert tb.send_peer(0, MsgKind.ACCEPT, frame)
+            tb.flush_all()
+            src, conn, kind, rows = ta.queue.get(timeout=5)
+            assert (src, conn, kind) == (FROM_PEER, 1, MsgKind.ACCEPT)
+            return rows.tobytes()
+
+        base = send_and_recv()
+        ta.set_chaos(ChaosShim(0, FaultPlan(2, seed=1), ta.queue))
+        assert send_and_recv() == base  # no-op plan: transparent
+        ta.set_chaos(None)
+        assert send_and_recv() == base  # healed: transparent
+        # and a real fault actually bites: inbound block 1->0
+        ta.set_chaos(ChaosShim(
+            0, FaultPlan(2, seed=1).set_link(1, 0, block=True), ta.queue))
+        assert tb.send_peer(0, MsgKind.ACCEPT, frame)
+        tb.flush_all()
+        with pytest.raises(queue.Empty):
+            ta.queue.get(timeout=0.4)
+        assert ta.chaos.counts()["blocked_in"] == 1
+        assert ta.chaos_faults_total() == 1
+        # outbound block swallows at the sender, reporting success
+        tb.set_chaos(ChaosShim(
+            1, FaultPlan(2, seed=1).set_link(1, 0, block=True), tb.queue))
+        assert tb.send_peer(0, MsgKind.ACCEPT, frame)
+        assert tb.chaos.counts()["blocked_out"] == 1
+    finally:
+        ta.stop()
+        tb.stop()
+
+
+def test_dial_peer_backoff_grows_per_peer():
+    """Repeated refused dials double the per-peer suppression window
+    (capped); suppressed vs refused are distinct tallies."""
+    dead = free_ports(1)[0]  # nothing listening: connect refused fast
+    addrs = [("127.0.0.1", free_ports(1)[0]), ("127.0.0.1", dead)]
+    t = Transport(0, addrs)
+    try:
+        assert not t.dial_peer(1)  # refused
+        assert t._dial_tallies["refused"] == 1
+        assert not t.dial_peer(1)  # inside the grown window: suppressed
+        assert t._dial_tallies["suppressed"] == 1
+        w1 = t._dial_window[1]
+        t._last_dial[1] = -1e9  # age out the window, fail again
+        assert not t.dial_peer(1)
+        assert t._dial_tallies["refused"] == 2
+        assert t._dial_window[1] == min(2 * w1, t.DIAL_BACKOFF_CAP_S)
+        # an inbound connection resets the backoff
+        t._install_peer(1, _FakeSock())
+        assert 1 not in t._dial_fails and 1 not in t._dial_window
+    finally:
+        t.stop()
+
+
+class _FakeSock:
+    def close(self):
+        pass
+
+    def recv(self, n):
+        return b""  # read loop exits immediately
+
+    def fileno(self):
+        return -1
+
+
+def test_backoff_sleeps_seeded_and_bounded():
+    from minpaxos_tpu.runtime.master import backoff_sleeps
+
+    def seq(seed, n=8):
+        g = backoff_sleeps(0.05, 2.0, np.random.default_rng(seed))
+        return [next(g) for _ in range(n)]
+
+    assert seq(4) == seq(4)
+    assert seq(4) != seq(5)
+    for i, s in enumerate(seq(4)):
+        nominal = min(0.05 * 2 ** i, 2.0)
+        assert 0.5 * nominal <= s <= nominal
+    assert max(seq(4, 12)) <= 2.0
+
+
+# ------------------------------------------------- stable store CRC
+
+def _mk_store(path, n=5, frontier=4):
+    s = StableStore(str(path), sync=True)
+    s.append_slots(np.arange(n), np.full(n, 16), np.full(n, 4),
+                   np.ones(n), np.arange(n) * 10, np.arange(n) * 100,
+                   np.arange(n), np.zeros(n))
+    s.append_frontier(frontier)
+    s.flush()
+    s.close()
+
+
+def test_store_crc_bit_flip_skipped_and_healed(tmp_path, capsys):
+    """A flipped payload byte must be detected (CRC), skipped with a
+    warning + counter, leave a non-committed hole, and converge once
+    the records are re-appended (the peer re-send heal path)."""
+    path = tmp_path / "store"
+    _mk_store(path)
+    raw = bytearray(path.read_bytes())
+    raw[8 + 5 + 4 + 6] ^= 0xFF  # inside the first record's payload
+    path.write_bytes(bytes(raw))
+    r = StableStore(str(path))
+    assert r.corrupt_records == 1
+    assert "CRC mismatch" in capsys.readouterr().err
+    # the whole slots batch was one record: its slots are holes now
+    assert not r.is_committed(np.arange(5)).any()
+    assert r.committed_prefix() == -1  # frontier record intact, no slots
+    assert r.frontier == 4
+    # peers re-send the commits: recovery converges
+    n = 5
+    r.append_slots(np.arange(n), np.full(n, 16), np.full(n, 4),
+                   np.ones(n), np.arange(n) * 10, np.arange(n) * 100,
+                   np.arange(n), np.zeros(n))
+    r.flush()
+    assert r.committed_prefix() == 4
+    assert r.is_committed(np.arange(5)).all()
+    r.close()
+    # and the healed log replays clean
+    r2 = StableStore(str(path))
+    assert r2.corrupt_records == 1  # the flipped record is still there
+    assert r2.committed_prefix() == 4
+    r2.close()
+
+
+def test_store_mid_log_truncation_converges(tmp_path):
+    """A crash-truncated log replays its intact prefix; re-appending
+    the lost tail (leader catch-up) converges to the full prefix."""
+    path = tmp_path / "store"
+    s = StableStore(str(path), sync=True)
+    s.append_slots(np.arange(3), np.full(3, 16), np.full(3, 4),
+                   np.ones(3), np.zeros(3), np.zeros(3), np.arange(3),
+                   np.zeros(3))
+    s.append_frontier(2)
+    s.flush()
+    size_after_first = path.stat().st_size
+    s.append_slots(np.arange(3, 6), np.full(3, 16), np.full(3, 4),
+                   np.ones(3), np.zeros(3), np.zeros(3), np.arange(3),
+                   np.zeros(3))
+    s.append_frontier(5)
+    s.close()
+    with open(path, "r+b") as f:  # cut into the second slots record
+        f.truncate(size_after_first + 20)
+    r = StableStore(str(path))
+    assert r.committed_prefix() == 2
+    assert r.corrupt_records == 0  # torn tail, not corruption
+    r.append_slots(np.arange(3, 6), np.full(3, 16), np.full(3, 4),
+                   np.ones(3), np.zeros(3), np.zeros(3), np.arange(3),
+                   np.zeros(3))
+    r.append_frontier(5)
+    r.flush()
+    assert r.committed_prefix() == 5
+    r.close()
+    r2 = StableStore(str(path))
+    assert r2.committed_prefix() == 5 and r2.corrupt_records == 0
+    r2.close()
+
+
+def test_store_corrupt_length_field_resyncs_not_truncates(tmp_path,
+                                                          capsys):
+    """A flipped LENGTH byte mid-file declares a record that runs past
+    EOF — indistinguishable from a torn tail at the break check. The
+    CRC resync must recover every valid record after it; without it,
+    the open-time torn-tail truncation would amplify one bad byte into
+    irreversible loss of the whole (committed) suffix."""
+    path = tmp_path / "store"
+    s = StableStore(str(path), sync=True)
+    s.append_slots(np.arange(3), np.full(3, 16), np.full(3, 4),
+                   np.ones(3), np.zeros(3), np.zeros(3), np.arange(3),
+                   np.zeros(3))
+    s.append_frontier(2)
+    s.append_slots(np.arange(3, 6), np.full(3, 16), np.full(3, 4),
+                   np.ones(3), np.zeros(3), np.zeros(3), np.arange(3),
+                   np.zeros(3))
+    s.append_frontier(5)
+    s.close()
+    size = path.stat().st_size
+    raw = bytearray(path.read_bytes())
+    raw[12] |= 0x80  # first record's len u32 high byte: way past EOF
+    path.write_bytes(bytes(raw))
+    r = StableStore(str(path))
+    assert r.corrupt_records == 1
+    assert "resynced" in capsys.readouterr().err
+    # the suffix survived: both frontiers and the second slots batch
+    assert r.frontier == 5
+    assert r.is_committed(np.arange(3, 6)).all()
+    assert not r.is_committed(np.arange(3)).any()  # the lost record
+    assert path.stat().st_size == size  # nothing truncated away
+    # peers re-send the lost slots: recovery converges
+    r.append_slots(np.arange(3), np.full(3, 16), np.full(3, 4),
+                   np.ones(3), np.zeros(3), np.zeros(3), np.arange(3),
+                   np.zeros(3))
+    r.flush()
+    assert r.committed_prefix() == 5
+    r.close()
+    r2 = StableStore(str(path))  # garbage still in place, still skipped
+    assert r2.corrupt_records == 1 and r2.committed_prefix() == 5
+    r2.close()
+
+
+def test_store_v1_log_replays_and_appends_v1(tmp_path):
+    """Pre-CRC (MPXL0001) files keep working: replay ignores the
+    missing CRCs and appends stay in v1 framing so the file remains
+    self-consistent."""
+    path = tmp_path / "store"
+    rec = np.zeros(3, SLOT_DT)
+    rec["inst"] = np.arange(3)
+    rec["ballot"] = 16
+    rec["status"] = 4
+    rec["val"] = [7, 8, 9]
+    payload = rec.tobytes()
+    with open(path, "wb") as f:
+        f.write(MAGIC_V1)
+        f.write(struct.pack("<BI", REC_SLOTS, len(payload)) + payload)
+        f.write(struct.pack("<BI", REC_FRONTIER, 4) + struct.pack("<i", 2))
+    s = StableStore(str(path))
+    assert not s.crc_framing
+    assert s.committed_prefix() == 2
+    np.testing.assert_array_equal(s.read_range(0, 2)["val"], [7, 8, 9])
+    s.append_slots([3], [16], [4], [1], [0], [10], [3], [0])
+    s.append_frontier(3)
+    s.close()
+    r = StableStore(str(path))
+    assert r.committed_prefix() == 3 and r.corrupt_records == 0
+    r.close()
+
+
+# ------------------------------------------------- recorder (v3 row)
+
+def test_recorder_chaos_counter_track():
+    from minpaxos_tpu.obs.recorder import (
+        KIND_FULL,
+        FlightRecorder,
+        chrome_trace,
+        validate_chrome_trace,
+    )
+
+    rec = FlightRecorder(8)
+    rec.record(1_000_000, KIND_FULL, 1, 4, 4, 10, 0, 1, 2, 3, 0, 4, 5, 6,
+               900_000)
+    rec.record(3_000_000, KIND_FULL, 1, 4, 4, 11, 0, 1, 2, 3, 0, 4, 5, 6,
+               2_900_000, chaos_faults=17)
+    events = rec.to_events(pid=0)
+    assert validate_chrome_trace(chrome_trace(events)) == []
+    cs = [e for e in events if e["name"] == "chaos_faults"]
+    assert len(cs) == 1 and cs[0]["args"]["chaos_faults"] == 17
+
+
+# ------------------------------------------------------ integration
+
+def test_partition_leader_stalls_heals_converges():
+    """THE paxchaos scenario: partition the leader from the majority on
+    a live cluster mid-workload — progress must stall (a minority
+    leader committing would be the safety bug), the partition must
+    inject real faults, and after healing the cluster must converge,
+    resume committing, and pass every invariant (byte-identical
+    committed prefixes, monotonic frontiers, linearizable per-key
+    history, exactly-once replies)."""
+    from minpaxos_tpu.chaos.campaign import run_schedule
+
+    r = run_schedule("isolated_leader", seed=42, ops_n=150)
+    assert r["ok"], r
+    assert r["stall_observed"], r
+    assert r["faults_injected"] > 0, r
+    assert r["resumed_commits"] and r["converged"], r
+    assert r["check"]["ok"] and r["check"]["violations"] == [], r
+    assert r["duplicates"] == 0 and r["acked"] == r["expected"] > 0, r

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""paxchaos campaign runner: seeded network-fault schedules against a
+real in-process cluster, invariant-checked after every one.
+
+    tools/chaos.py                      # all 8 schedules, default seed
+    tools/chaos.py --seeds 7,1234      # replay specific seeds
+    tools/chaos.py --schedules isolated_leader --seeds 42
+    tools/chaos.py --smoke             # CI gate: 2 fixed seeds, quick
+                                       # schedule pair, 60 s budget,
+                                       # JSON verdict (run_tier1.sh)
+    tools/chaos.py --json out.json     # write the full verdict
+
+Every run prints its seed; a failing (schedule, seed) pair reproduces
+the identical fault schedule — the event times, the per-link drop/
+delay/duplicate/reorder decisions, and the client's backoff jitter are
+all derived from it (ROBUSTNESS.md has the fault model and recipes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+#: the CI smoke's schedule subset: one partition-heal (the canonical
+#: safety scenario) + one loss/reorder soak (the messy-network one) —
+#: quick enough for the 60 s budget, distinct enough to cover both
+#: fault families
+SMOKE_SCHEDULES = ["partition_heal", "loss_reorder"]
+SMOKE_SEEDS = [1009, 2003]  # fixed: CI failures replay bit-identically
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("paxchaos")
+    p.add_argument("--schedules", default="all",
+                   help="comma-separated schedule names, or 'all'")
+    p.add_argument("--seeds", default="1009",
+                   help="comma-separated campaign seeds")
+    p.add_argument("--n", type=int, default=3, help="replicas")
+    p.add_argument("--ops", type=int, default=400,
+                   help="sizes the closed-loop load chunks (the loader "
+                        "proposes continuously until the schedule's "
+                        "last fault event has fired)")
+    p.add_argument("--budget", type=float, default=0.0,
+                   help="wall budget in seconds (0 = none), measured "
+                        "from the end of the first run (the first "
+                        "cluster boot pays the one-time jit compile)")
+    p.add_argument("--json", default="",
+                   help="also write the full verdict to this file")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate mode: fixed seeds "
+                        f"{SMOKE_SEEDS}, schedules {SMOKE_SCHEDULES}, "
+                        "60 s budget, exit nonzero on any failure")
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from minpaxos_tpu.utils.backend import enable_compile_cache
+
+    enable_compile_cache()
+
+    from minpaxos_tpu.chaos.campaign import SCHEDULES, run_campaign
+
+    pairs = None
+    if args.smoke:
+        schedules, seeds = SMOKE_SCHEDULES, SMOKE_SEEDS
+        # one run per fixed seed (seed i drives schedule i): two full
+        # boot+fault+check cycles fit the 60 s budget, the full product
+        # does not on a 1-core host (each cluster boot is ~20 s there)
+        pairs = list(zip(SMOKE_SEEDS, SMOKE_SCHEDULES))
+        budget = 60.0
+        ops_n = 250
+    else:
+        schedules = (list(SCHEDULES) if args.schedules == "all"
+                     else args.schedules.split(","))
+        seeds = [int(s) for s in args.seeds.split(",")]
+        budget = args.budget or None
+        ops_n = args.ops
+    for s in schedules:
+        if s not in SCHEDULES:
+            p.error(f"unknown schedule {s!r} (have: {', '.join(SCHEDULES)})")
+
+    t0 = time.monotonic()
+    verdict = run_campaign(schedules, seeds, n=args.n, ops_n=ops_n,
+                           budget_s=budget, pairs=pairs)
+    verdict["wall_s"] = round(time.monotonic() - t0, 2)
+    line = {"ok": verdict["ok"], "runs": len(verdict["runs"]),
+            "failed": [
+                {"schedule": r.get("schedule"), "seed": r.get("seed"),
+                 "error": r.get("error"),
+                 "violations": r.get("check", {}).get("violations")}
+                for r in verdict["runs"] if not r.get("ok")],
+            "wall_s": verdict["wall_s"]}
+    print(f"[chaos] verdict: {json.dumps(line)}", flush=True)
+    if args.json:
+        Path(args.json).write_text(json.dumps(verdict, indent=1))
+        print(f"[chaos] full verdict written to {args.json}", flush=True)
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

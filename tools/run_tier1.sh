@@ -29,6 +29,13 @@ python tools/lint.py || exit 1
 echo "== paxmon smoke (recorder overhead + paxtop --once --json) =="
 python tools/obs_smoke.py || exit 1
 
+# paxchaos smoke third: two fixed-seed fault schedules (partition-heal
+# + 10% loss/reorder) against a real in-process cluster, invariant-
+# checked (ROBUSTNESS.md). This one boots JAX; the budget clock starts
+# after the first run so the one-time jit compile doesn't count.
+echo "== paxchaos smoke (2 seeded fault schedules + invariant checker) =="
+env JAX_PLATFORMS=cpu python tools/chaos.py --smoke || exit 1
+
 if [ "${1:-}" = "smoke" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         -k "runtime_units or wire or fused" \
