@@ -14,8 +14,17 @@ and tier-1 tests only catch by luck:
   between builds that were never supposed to disagree.
 * **Threaded-runtime races** — the TCP runtime is single-owner by
   convention (transport.py docstring); a shared-attribute write from a
-  reader thread without the owning ``_lock``, or a blocking socket
-  call made while holding it, breaks that convention silently.
+  reader thread without the owning ``_lock``, a blocking socket call
+  made while holding it, or a cycle in the lock-acquisition graph
+  (two threads taking the same pair of locks in opposite orders)
+  breaks that convention silently.
+* **Protocol-logic hazards** — a quorum is just a threshold in the
+  kernels' majority-mask compare, so a non-intersecting (q1, q2)
+  configuration compiles and passes healthy-network tests; the
+  ``quorum-certificate`` pass holds every threshold expression to the
+  certified ledger ``quorum_golden.py`` (``verify/quorum.py`` proofs,
+  re-derived every run), and the paxmc model checker (VERIFY.md)
+  demonstrates the split-brain a forbidden threshold causes.
 
 ``tools/lint.py`` runs every registered pass over the tree and exits
 nonzero on violations; ``tools/run_tier1.sh`` runs it before pytest so
@@ -39,6 +48,8 @@ from minpaxos_tpu.analysis.core import (
 from minpaxos_tpu.analysis import (  # noqa: E402,F401  (registration)
     broad_except,
     concurrency,
+    lock_order,
+    quorum_certificate,
     recompile_hazard,
     trace_hazard,
     wall_honesty,

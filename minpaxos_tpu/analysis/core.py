@@ -102,11 +102,19 @@ class Project:
     def __init__(self, files: dict[str, str], root: Path | None = None):
         self.root = root
         self.files: dict[str, SourceFile] = {}
+        # work counters, asserted by tests/test_paxlint.py: every file
+        # is ast.parse'd exactly once per Project (here), every device
+        # module is structure-walked once (jitgraph module cache), and
+        # the jit call-graph fixed point runs once per lint invocation
+        # no matter how many passes consult it
+        self.stats = {"ast_parses": 0, "module_walks": 0,
+                      "graph_builds": 0}
         for path, src in sorted(files.items()):
             path = path.replace("\\", "/")
             f = SourceFile(path=path, src=src)
             try:
                 f.tree = ast.parse(src, filename=path)
+                self.stats["ast_parses"] += 1
             except SyntaxError as e:
                 f.error = f"syntax error: {e.msg} (line {e.lineno})"
             f.suppress_lines, f.suppress_file = _parse_suppressions(src)

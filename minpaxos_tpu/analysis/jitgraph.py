@@ -39,6 +39,15 @@ STRUCTURAL_CALLS = frozenset(
 # parameter names conventionally carrying static config pytrees
 STATIC_PARAM_NAMES = frozenset({"cfg", "config"})
 
+#: the canonical jit-reachability scope: every package that contains
+#: or calls device code. ALL passes build their graph over this one
+#: tuple (trace-hazard narrows what it REPORTS separately), so one
+#: lint invocation pays for exactly one fixed point — the per-pass
+#: prefix copies this replaces silently forked the cache whenever one
+#: drifted.
+DEVICE_PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/",
+                   "minpaxos_tpu/runtime/", "minpaxos_tpu/parallel/")
+
 FuncKey = tuple[str, str]  # (file path, function name)
 
 
@@ -229,26 +238,39 @@ class Graph:
     # -- construction --
 
     @classmethod
-    def build(cls, project, prefixes: tuple[str, ...]) -> "Graph":
-        # the trace and recompile passes build over the same prefixes;
-        # cache the fixed point on the project so one lint invocation
-        # pays for it once
+    def build(cls, project,
+              prefixes: tuple[str, ...] = DEVICE_PREFIXES) -> "Graph":
+        # ONE fixed point per lint invocation: the graph is cached on
+        # the project per prefixes tuple (all in-tree passes use the
+        # DEVICE_PREFIXES default), and the parsed Modules are cached
+        # by path independently, so even a pass asking for a narrower
+        # scope never re-walks a module's structure
         cache = getattr(project, "_jitgraph_cache", None)
         if cache is None:
             cache = project._jitgraph_cache = {}
         if prefixes in cache:
             return cache[prefixes]
+        modcache = getattr(project, "_module_cache", None)
+        if modcache is None:
+            modcache = project._module_cache = {}
+        stats = getattr(project, "stats", None)
         g = cls()
         for prefix in prefixes:
             for f in project.glob(prefix):
                 if f.tree is None or f.path in g.modules:
                     continue
-                m = parse_module(f.path, f.tree)
+                m = modcache.get(f.path)
+                if m is None:
+                    m = modcache[f.path] = parse_module(f.path, f.tree)
+                    if stats is not None:
+                        stats["module_walks"] += 1
                 g.modules[f.path] = m
                 g._by_modname[_modname(f.path)] = m
         for m in g.modules.values():
             g.wraps.extend(find_jit_wraps(m))
         g._propagate()
+        if stats is not None:
+            stats["graph_builds"] += 1
         cache[prefixes] = g
         return g
 

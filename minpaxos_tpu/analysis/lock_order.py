@@ -1,0 +1,293 @@
+"""lock-order: the runtime's lock-acquisition graph must be acyclic.
+
+The threaded TCP runtime (transport, replica, master) holds few locks,
+but they nest across objects: a master control handler can hold the
+master's ``_lock`` while fanning out to replicas through transport
+helpers that take the transport's ``_lock``. Two such paths acquiring
+the same pair of locks in opposite orders deadlock — not in tests,
+but under production contention, as a wedge with no traceback (both
+threads alive, both blocked). The ``concurrency`` pass checks each
+lock's discipline in isolation; this pass checks the *relation
+between* locks:
+
+* every ``with self.<lock>:`` (or manual ``acquire``) establishes the
+  held set for its body;
+* acquiring lock B while lock A is held adds the edge A -> B; call
+  chains are followed through same-class ``self.method()`` calls and
+  cross-class ``self.<attr>.method()`` calls when ``<attr>``'s class
+  is discoverable from a ``self.<attr> = ClassName(...)`` assignment
+  in the scoped files;
+* a cycle in the resulting directed graph is a violation naming the
+  full cycle and one acquisition site per edge.
+
+Nodes are ``(ClassName, lock_attr)`` — two classes' ``_lock``s are
+distinct locks. The pass is scoped to ``runtime/`` (transport,
+replica, master: the threads that actually contend); ``cli/`` wrappers
+spawn those same objects and add no locks of their own.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "lock-order"
+
+SCOPE_PREFIXES = ("minpaxos_tpu/runtime/",)
+
+#: recursion guard for call-chain following (the runtime's chains are
+#: depth 2-3; anything deeper is a pathological fixture)
+_MAX_CALL_DEPTH = 8
+
+LockNode = tuple[str, str]  # (class name, lock attr name)
+
+
+def _is_lock_name(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _lock_of_with_item(expr: ast.expr) -> str | None:
+    """``with self._lock:`` -> "_lock" (only self-attribute locks form
+    graph nodes; a local alias of someone else's lock is untrackable
+    and left to the concurrency pass)."""
+    attr = _self_attr(expr)
+    if attr is not None and _is_lock_name(attr):
+        return attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, path: str, node: ast.ClassDef):
+        self.path = path
+        self.raw_name = node.name  # source name, used for resolution
+        self.name = node.name  # node label; qualified when ambiguous
+        self.methods: dict[str, ast.FunctionDef] = {
+            n.name: n for n in node.body if isinstance(n, ast.FunctionDef)}
+        #: self.<attr> -> class name, from `self.x = ClassName(...)`
+        self.attr_classes: dict[str, str] = {}
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Assign):
+                continue
+            call = n.value
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)):
+                continue
+            for t in n.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    self.attr_classes[attr] = call.func.id
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "path", "line", "site")
+
+    def __init__(self, src: LockNode, dst: LockNode, path: str, line: int,
+                 site: str):
+        self.src, self.dst = src, dst
+        self.path, self.line, self.site = path, line, site
+
+
+class _GraphBuilder:
+    def __init__(self, classes: list[_ClassInfo]):
+        self.classes = classes
+        #: source class name -> every scoped class bearing it (two
+        #: files may each define a `Conn`; neither may shadow the
+        #: other — all of them get walked, and cross-class resolution
+        #: disambiguates below)
+        self.by_name: dict[str, list[_ClassInfo]] = {}
+        for ci in classes:
+            self.by_name.setdefault(ci.raw_name, []).append(ci)
+        self.edges: dict[tuple[LockNode, LockNode], _Edge] = {}
+
+    def resolve_class(self, name: str | None,
+                      from_path: str) -> _ClassInfo | None:
+        """Resolve a constructor name to a scoped class: same-file
+        definition wins; a unique cross-file one is accepted; an
+        ambiguous name (several files, none local) is skipped rather
+        than guessed — a wrong binding would draw phantom edges."""
+        cands = self.by_name.get(name, []) if name else []
+        local = [c for c in cands if c.path == from_path]
+        if len(local) == 1:
+            return local[0]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    def add_edge(self, src: LockNode, dst: LockNode, path: str, line: int,
+                 site: str) -> None:
+        if src != dst:  # same-lock re-entry is the concurrency pass's
+            self.edges.setdefault((src, dst), _Edge(src, dst, path, line,
+                                                    site))
+
+    def walk_method(self, ci: _ClassInfo, method: ast.FunctionDef,
+                    held: tuple[LockNode, ...], depth: int,
+                    seen: set[tuple[str, str, tuple]]) -> None:
+        key = (ci.name, method.name, held)
+        if depth > _MAX_CALL_DEPTH or key in seen:
+            return
+        seen.add(key)
+        self._walk_body(ci, method, method.body, held, depth, seen)
+
+    def _walk_body(self, ci: _ClassInfo, method: ast.FunctionDef,
+                   body: list[ast.stmt], held: tuple[LockNode, ...],
+                   depth: int, seen: set) -> None:
+        for stmt in body:
+            self._walk_stmt(ci, method, stmt, held, depth, seen)
+
+    def _walk_stmt(self, ci: _ClassInfo, method: ast.FunctionDef,
+                   stmt: ast.stmt, held: tuple[LockNode, ...],
+                   depth: int, seen: set) -> None:
+        if isinstance(stmt, ast.With):
+            inner = held
+            for item in stmt.items:
+                lock = _lock_of_with_item(item.context_expr)
+                if lock is not None:
+                    node: LockNode = (ci.name, lock)
+                    for h in inner:
+                        self.add_edge(
+                            h, node, ci.path, stmt.lineno,
+                            f"{ci.name}.{method.name}")
+                    inner = inner + (node,)
+            self._walk_body(ci, method, stmt.body, inner, depth, seen)
+            return
+        if isinstance(stmt, ast.FunctionDef):
+            return  # nested def: analyzed only if called (not tracked)
+        # compound statements: recurse into every sub-body so a `with`
+        # inside an if/for/try still extends the held set correctly
+        sub_bodies = [getattr(stmt, f) for f in ("body", "orelse",
+                                                 "finalbody")
+                      if getattr(stmt, f, None)]
+        if isinstance(stmt, ast.Try):
+            for h in stmt.handlers:
+                sub_bodies.append(h.body)
+        if isinstance(stmt, ast.Match):
+            for case in stmt.cases:  # match arms are not plain bodies
+                sub_bodies.append(case.body)
+        if sub_bodies:
+            # calls in the statement's own expressions (test, iter, ...)
+            for field, node in ast.iter_fields(stmt):
+                if field in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                for sub in ast.walk(node) if isinstance(node, ast.AST) \
+                        else ():
+                    if isinstance(sub, ast.Call):
+                        self._follow_call(ci, sub, held, depth, seen)
+            for body in sub_bodies:
+                self._walk_body(ci, method, body, held, depth, seen)
+            return
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._follow_call(ci, node, held, depth, seen)
+
+    def _follow_call(self, ci: _ClassInfo, call: ast.Call,
+                     held: tuple[LockNode, ...], depth: int,
+                     seen: set) -> None:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return
+        # manual acquire: self._lock.acquire() under a held lock is an
+        # edge too (the concurrency pass stands down on manual flow;
+        # the ORDER still matters)
+        if f.attr == "acquire":
+            base = _self_attr(f.value)
+            if base is not None and _is_lock_name(base):
+                node: LockNode = (ci.name, base)
+                for h in held:
+                    self.add_edge(h, node, ci.path, call.lineno,
+                                  f"{ci.name}.(manual acquire)")
+            return
+        # self.method(...)
+        base = _self_attr(f.value) if isinstance(f.value, ast.Attribute) \
+            else None
+        if isinstance(f.value, ast.Name) and f.value.id == "self":
+            callee = ci.methods.get(f.attr)
+            if callee is not None:
+                self.walk_method(ci, callee, held, depth + 1, seen)
+            return
+        # self.<attr>.method(...) -> another scoped class's method
+        if base is not None:
+            target = self.resolve_class(ci.attr_classes.get(base), ci.path)
+            if target is not None:
+                callee = target.methods.get(f.attr)
+                if callee is not None:
+                    self.walk_method(target, callee, held, depth + 1, seen)
+
+
+def _find_cycles(edges: dict[tuple[LockNode, LockNode], _Edge]):
+    """Minimal directed cycles via DFS; yields one representative path
+    (list of edges) per strongly-connected loop discovered."""
+    adj: dict[LockNode, list[LockNode]] = {}
+    for src, dst in edges:
+        adj.setdefault(src, []).append(dst)
+    reported: set[frozenset[LockNode]] = set()
+    cycles = []
+
+    def dfs(start: LockNode, node: LockNode, path: list[LockNode],
+            visited: set[LockNode]) -> None:
+        for nxt in adj.get(node, ()):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in reported:
+                    reported.add(key)
+                    cycles.append(list(path))
+            elif nxt not in visited:
+                visited.add(nxt)
+                path.append(nxt)
+                dfs(start, nxt, path, visited)
+                path.pop()
+                visited.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    classes: list[_ClassInfo] = []
+    for f in sorted(project.files):
+        sf = project.files[f]
+        if sf.tree is None or not sf.path.startswith(SCOPE_PREFIXES):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                classes.append(_ClassInfo(sf.path, node))
+    # duplicate class names across files: every one is analyzed, and
+    # their lock NODES are qualified by file stem so two `Conn._lock`s
+    # neither merge (phantom cycles) nor shadow (missed cycles)
+    counts: dict[str, int] = {}
+    for ci in classes:
+        counts[ci.raw_name] = counts.get(ci.raw_name, 0) + 1
+    for ci in classes:
+        if counts[ci.raw_name] > 1:
+            stem = ci.path.rsplit("/", 1)[-1].removesuffix(".py")
+            ci.name = f"{stem}:{ci.raw_name}"
+    builder = _GraphBuilder(classes)
+    for ci in classes:
+        for method in ci.methods.values():
+            builder.walk_method(ci, method, (), 0, set())
+    out: list[Violation] = []
+    for cycle in _find_cycles(builder.edges):
+        ring = cycle + [cycle[0]]
+        hops = []
+        first = None
+        for a, b in zip(ring, ring[1:]):
+            e = builder.edges[(a, b)]
+            if first is None:
+                first = e
+            hops.append(f"{a[0]}.{a[1]} -> {b[0]}.{b[1]} "
+                        f"(in {e.site}, {e.path}:{e.line})")
+        out.append(Violation(
+            first.path, first.line, RULE,
+            "lock-order cycle — two threads taking these locks in "
+            "opposite orders deadlock with no traceback: "
+            + "; ".join(hops)))
+    return out

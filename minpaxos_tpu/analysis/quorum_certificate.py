@@ -1,0 +1,316 @@
+"""quorum-certificate: every quorum threshold must carry a proof.
+
+In the vectorized kernels a quorum is a bare threshold in a
+majority-mask compare (``n_votes >= majority``), so the entire Paxos
+intersection argument — every phase-1 quorum meets every phase-2
+quorum — lives in a handful of arithmetic expressions in ``ops/`` and
+``models/``. This pass holds each of them to the certified ledger
+``analysis/quorum_golden.py`` (certificates from
+``verify/quorum.py``, re-proved from scratch on every run):
+
+* a **quorum definition** (an assignment or 0-arg method/property
+  whose name is quorum-ish: ``majority``, ``quorum*``, ``q1``/``q2``)
+  must be either a *delegation* (reading another quorum-named
+  attribute, certified where defined) or a *formula* over the replica
+  count — which is then evaluated for every n in [1, GOLDEN_MAX_N]
+  and required to land on a certified-intersecting (q1, q2) pair.
+  ``q1``/``q2``-named definitions in one scope are paired against
+  each other; a lone ``majority``/``quorum`` is paired with itself.
+* a **fixed integer literal** used as a quorum definition, or
+  compared against a vote-count expression (``... >= 2`` against
+  ``n_votes``/``pv_cnt``/``prepare_oks.sum()``), cannot be certified
+  across replica counts and is flagged.
+* the **ledger itself** is re-verified: an entry that stops proving
+  (or a refuted pair smuggled in) is a violation at quorum_golden.py.
+
+Failure mode this prevents: ROADMAP item 2 makes (q1, q2) tunable —
+|Q1| + |Q2| <= N compiles fine, passes every healthy-network test,
+and commits two values for one slot under the first asymmetric
+partition. The bounded model checker (tools/mc.py) demonstrates that
+exact failure from a seeded non-intersecting mutant; this pass keeps
+the mutant out of the tree statically.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+from minpaxos_tpu.analysis.quorum_golden import (
+    GOLDEN_GRIDS,
+    GOLDEN_MAX_N,
+    GOLDEN_THRESHOLDS,
+    THRESHOLD_FORMULAS,
+)
+from minpaxos_tpu.verify.quorum import (
+    certify_grid,
+    certify_threshold,
+    verify_certificate,
+)
+
+RULE = "quorum-certificate"
+
+SCOPE_PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/")
+LEDGER_PATH = "minpaxos_tpu/analysis/quorum_golden.py"
+
+#: names that denote a quorum threshold; q1/q2 pin the phase
+_QUORUM_RE = re.compile(r"(^|_)(majority|quorum\d*|q1|q2)($|_)",
+                        re.IGNORECASE)
+_PHASE1_RE = re.compile(r"(^|_)(q1|quorum1|prepare_quorum)($|_)",
+                        re.IGNORECASE)
+_PHASE2_RE = re.compile(r"(^|_)(q2|quorum2|accept_quorum)($|_)",
+                        re.IGNORECASE)
+#: expressions that count votes (the compare side of the threshold)
+_VOTEISH_RE = re.compile(r"(^|_)(votes|n_votes|pv_cnt|vote_cov|oks|acks)"
+                         r"($|_)", re.IGNORECASE)
+#: names that denote the replica count inside a formula
+_NREPL_RE = re.compile(r"(^|_)(n_replicas|num_replicas|nreplicas)($|_)"
+                       r"|^[nN]$")
+
+_ALLOWED_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Mod)
+
+
+def _is_quorum_name(name: str) -> bool:
+    return bool(_QUORUM_RE.search(name))
+
+
+def _phase(name: str) -> str:
+    if _PHASE1_RE.search(name):
+        return "q1"
+    if _PHASE2_RE.search(name):
+        return "q2"
+    return "both"
+
+
+def _formula(node: ast.expr):
+    """Compile a threshold expression into ``f(n)``, or return None if
+    it is not a recognizable arithmetic formula over the replica
+    count. Delegations (reads of another quorum-named attribute or
+    name) return the string "delegated"."""
+    if isinstance(node, ast.Attribute) and _is_quorum_name(node.attr):
+        return "delegated"
+    if isinstance(node, ast.Name) and _is_quorum_name(node.id):
+        return "delegated"
+
+    def ev(e: ast.expr, n: int):
+        if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                and not isinstance(e.value, bool):
+            return e.value
+        if isinstance(e, ast.Name):
+            if _NREPL_RE.search(e.id):
+                return n
+            raise ValueError(e.id)
+        if isinstance(e, ast.Attribute):
+            if _NREPL_RE.search(e.attr):
+                return n
+            raise ValueError(e.attr)
+        if isinstance(e, ast.BinOp) and isinstance(e.op, _ALLOWED_BINOPS):
+            lhs, rhs = ev(e.left, n), ev(e.right, n)
+            op = type(e.op)
+            if op is ast.Add:
+                return lhs + rhs
+            if op is ast.Sub:
+                return lhs - rhs
+            if op is ast.Mult:
+                return lhs * rhs
+            if op is ast.FloorDiv:
+                if rhs == 0:
+                    raise ValueError("div0")
+                return lhs // rhs
+            if rhs == 0:
+                raise ValueError("mod0")
+            return lhs % rhs
+        if isinstance(e, ast.UnaryOp) and isinstance(e.op, ast.USub):
+            return -ev(e.operand, n)
+        raise ValueError(ast.dump(e))
+
+    try:
+        probe = ev(node, 3)  # raises if unrecognized
+    except ValueError:
+        return None
+    del probe
+    return lambda n: ev(node, n)
+
+
+def _certify_pair(path: str, line: int, name1: str, name2: str, f1, f2,
+                  out: list[Violation]) -> None:
+    """Evaluate a (q1, q2) formula pair over every legal replica count
+    and hold each instantiation to the ledger."""
+    for n in range(1, GOLDEN_MAX_N + 1):
+        try:
+            q1, q2 = int(f1(n)), int(f2(n))
+        except ValueError:
+            continue  # formula undefined at this n (e.g. division)
+        if not (1 <= q1 <= n and 1 <= q2 <= n):
+            out.append(Violation(
+                path, line, RULE,
+                f"quorum threshold ({name1}={q1}, {name2}={q2}) is "
+                f"degenerate at n_replicas={n} (must satisfy "
+                f"1 <= q <= n)"))
+            return
+        cert = certify_threshold(n, q1, q2)
+        if not cert.intersects:
+            a, b = cert.witness
+            out.append(Violation(
+                path, line, RULE,
+                f"NON-INTERSECTING quorums at n_replicas={n}: "
+                f"{name1}={q1}, {name2}={q2} admit disjoint quorums "
+                f"{sorted(a)} / {sorted(b)} — two leaders could both "
+                f"assemble a quorum and commit different values"))
+            return
+        if (q1, q2) not in GOLDEN_THRESHOLDS.get(n, ()):
+            out.append(Violation(
+                path, line, RULE,
+                f"quorum pair ({name1}={q1}, {name2}={q2}) at "
+                f"n_replicas={n} intersects but is not covered by a "
+                f"certified entry — append it to "
+                f"analysis/quorum_golden.py (tools/mc.py "
+                f"--print-quorum-golden emits the table) in this PR"))
+            return
+
+
+def _check_ledger(out: list[Violation]) -> None:
+    """The ledger is certificates, not trust: re-prove every entry."""
+    for n, pairs in GOLDEN_THRESHOLDS.items():
+        for q1, q2 in pairs:
+            try:
+                cert = certify_threshold(n, q1, q2)
+            except ValueError as e:
+                out.append(Violation(LEDGER_PATH, 1, RULE,
+                                     f"ledger entry (n={n}, q1={q1}, "
+                                     f"q2={q2}) is malformed: {e}"))
+                continue
+            if not cert.intersects or not verify_certificate(cert):
+                out.append(Violation(
+                    LEDGER_PATH, 1, RULE,
+                    f"ledger entry (n={n}, q1={q1}, q2={q2}) fails to "
+                    f"re-prove intersection — a refuted pair must never "
+                    f"be recorded as certified"))
+    for rows, cols, q1, q2 in GOLDEN_GRIDS:
+        cert = certify_grid(rows, cols, q1, q2)
+        if not cert.intersects or not verify_certificate(cert):
+            out.append(Violation(
+                LEDGER_PATH, 1, RULE,
+                f"ledger grid entry ({rows}x{cols}, {q1}/{q2}) fails "
+                f"to re-prove intersection"))
+    for label, f in THRESHOLD_FORMULAS.items():
+        for n in range(1, GOLDEN_MAX_N + 1):
+            q = f(n)
+            if (q, q) not in GOLDEN_THRESHOLDS.get(n, ()):
+                out.append(Violation(
+                    LEDGER_PATH, 1, RULE,
+                    f"certified formula {label!r} evaluates to "
+                    f"uncovered pair ({q}, {q}) at n={n}"))
+
+
+class _ScopeDefs:
+    """Quorum definitions found in one lexical scope (module, class,
+    or function body), grouped for phase pairing."""
+
+    def __init__(self) -> None:
+        self.defs: list[tuple[str, str, int, object]] = []
+        # (name, phase, line, formula | "delegated")
+
+    def add(self, name: str, line: int, value: ast.expr) -> None:
+        f = _formula(value)
+        self.defs.append((name, _phase(name), line, f))
+
+
+def _scan_scope(path: str, body: list[ast.stmt],
+                out: list[Violation]) -> None:
+    scope = _ScopeDefs()
+    for node in body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and _is_quorum_name(t.id):
+                    scope.add(t.id, node.lineno, node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name) \
+                    and _is_quorum_name(node.target.id):
+                scope.add(node.target.id, node.lineno, node.value)
+        elif isinstance(node, ast.FunctionDef) \
+                and _is_quorum_name(node.name):
+            # a 0-arg method/property returning the threshold
+            rets = [s for s in ast.walk(node)
+                    if isinstance(s, ast.Return) and s.value is not None]
+            if len(rets) == 1:
+                scope.add(node.name, node.lineno, rets[0].value)
+            else:
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    f"quorum definition `{node.name}` has no single "
+                    f"return expression — cannot certify"))
+        # recurse into nested scopes (class bodies, functions)
+        if isinstance(node, (ast.ClassDef, ast.FunctionDef)):
+            _scan_scope(path, node.body, out)
+
+    live = [(nm, ph, ln, f) for nm, ph, ln, f in scope.defs
+            if f != "delegated"]
+    for nm, ph, ln, f in live:
+        if f is None:
+            out.append(Violation(
+                path, ln, RULE,
+                f"quorum definition `{nm}` is not a recognizable "
+                f"formula over the replica count — cannot certify "
+                f"intersection (delegate to a certified definition, "
+                f"or use an n_replicas formula from the ledger)"))
+    usable = [(nm, ph, ln, f) for nm, ph, ln, f in live if f is not None]
+    p1 = [(nm, ln, f) for nm, ph, ln, f in usable if ph == "q1"]
+    p2 = [(nm, ln, f) for nm, ph, ln, f in usable if ph == "q2"]
+    for nm, ln, f in ((nm, ln, f) for nm, ph, ln, f in usable
+                      if ph == "both"):
+        _certify_pair(path, ln, nm, nm, f, f, out)
+    for nm1, ln1, f1 in p1:
+        if p2:
+            for nm2, _ln2, f2 in p2:
+                _certify_pair(path, ln1, nm1, nm2, f1, f2, out)
+        else:
+            _certify_pair(path, ln1, nm1, nm1, f1, f1, out)
+    if not p1:
+        for nm2, ln2, f2 in p2:
+            _certify_pair(path, ln2, nm2, nm2, f2, f2, out)
+
+
+def _literal_vote_compares(path: str, tree: ast.Module,
+                           out: list[Violation]) -> None:
+    """``<vote count> >= <int literal>`` (either orientation): a fixed
+    quorum size is wrong for some replica count by construction."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], (ast.GtE, ast.Gt, ast.LtE, ast.Lt)):
+            continue
+        sides = (node.left, node.comparators[0])
+        for expr, other in (sides, sides[::-1]):
+            if not (isinstance(other, ast.Constant)
+                    and isinstance(other.value, int)
+                    and not isinstance(other.value, bool)
+                    # a quorum size is always >= 1; comparisons against
+                    # 0 are emptiness guards, not thresholds
+                    and other.value >= 1):
+                continue
+            names = {n.id for n in ast.walk(expr)
+                     if isinstance(n, ast.Name)}
+            names |= {a.attr for a in ast.walk(expr)
+                      if isinstance(a, ast.Attribute)}
+            if any(_VOTEISH_RE.search(nm) for nm in names):
+                out.append(Violation(
+                    path, node.lineno, RULE,
+                    f"vote count compared against fixed literal "
+                    f"{other.value}: a constant quorum threshold "
+                    f"cannot be certified across replica counts — "
+                    f"use a certified n_replicas formula"))
+                break
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    _check_ledger(out)
+    for f in project.files.values():
+        if f.tree is None or not f.path.startswith(SCOPE_PREFIXES):
+            continue
+        _scan_scope(f.path, f.tree.body, out)
+        _literal_vote_compares(f.path, f.tree, out)
+    return out

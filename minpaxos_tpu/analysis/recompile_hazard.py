@@ -27,8 +27,9 @@ from minpaxos_tpu.analysis.core import Project, Violation, register
 
 RULE = "recompile-hazard"
 
-PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/",
-            "minpaxos_tpu/runtime/", "minpaxos_tpu/parallel/")
+# the shared jit-reachability scope (one graph build per lint run,
+# shared with trace-hazard — jitgraph.DEVICE_PREFIXES)
+PREFIXES = jitgraph.DEVICE_PREFIXES
 
 _ARRAYISH = ("ndarray", "Array", "DeviceArray")
 

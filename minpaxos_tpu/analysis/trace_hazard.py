@@ -40,8 +40,9 @@ from minpaxos_tpu.analysis.jitgraph import value_tainted
 
 RULE = "trace-hazard"
 
-GRAPH_PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/",
-                  "minpaxos_tpu/runtime/", "minpaxos_tpu/parallel/")
+# graph over the shared scope (one build per lint run, shared with
+# recompile-hazard); REPORT narrows to the device packages only
+GRAPH_PREFIXES = jitgraph.DEVICE_PREFIXES
 REPORT_PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/")
 DEVICE_PACKAGE = "minpaxos_tpu/ops/"
 

@@ -14,7 +14,9 @@ drives closed-loop load from a ``-check`` client while applying the
 schedule through the master's ``cluster_chaos`` fan-out — the exact
 path an operator uses against a live deployment — then heals, proves
 the cluster still commits, waits for convergence, and runs the
-invariant checker (chaos/check.py) over the quiesced stores.
+invariant checker (verify/invariants.py, the same predicate suite the
+paxmc bounded model checker proves exhaustively at small bounds) over
+the quiesced stores.
 
 Used by ``tools/chaos.py`` (CLI + CI smoke) and tests/test_chaos.py.
 """
@@ -29,8 +31,11 @@ import zlib
 
 import numpy as np
 
-from minpaxos_tpu.chaos.check import check_cluster
+# the campaign certifies the SAME predicates the bounded model checker
+# (verify/mc.py) explores exhaustively — one invariant catalogue, two
+# provers (VERIFY.md)
 from minpaxos_tpu.chaos.plan import FaultPlan
+from minpaxos_tpu.verify.invariants import check_cluster
 
 #: committed-frontier sample cadence during load (drives the
 #: monotonicity check and the stall detector)
@@ -208,17 +213,26 @@ class ChaosCluster:
 # ---------------------------------------------------------- runner
 
 def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
-                 timeout_s: float = 60.0, log=print) -> dict:
+                 timeout_s: float = 60.0, log=print,
+                 events: list[tuple] | None = None) -> dict:
     """One schedule end-to-end; returns a JSON-able result dict whose
     ``ok`` is the conjunction of load completion, exactly-once replies,
     real fault injection (> 0), post-heal commit resumption,
     convergence, and the invariant checker (+ the stall proof for
     STALL_SCHEDULES). ``ops_n`` sizes the load chunks; total proposed
-    volume is however many chunks fit before the last fault event."""
+    volume is however many chunks fit before the last fault event.
+
+    ``events`` overrides the named schedule with an explicit timed
+    event list — the paxmc counterexample-replay path (``tools/mc.py
+    --emit-faultplan`` -> ``tools/chaos.py --plan-file``), where the
+    fault pattern comes from a model-checker trace rather than a
+    seeded generator."""
     from minpaxos_tpu.runtime.client import gen_workload
     from minpaxos_tpu.runtime.master import cluster_chaos
 
-    events = build_schedule(name, seed, n)
+    custom_events = events is not None
+    if events is None:
+        events = build_schedule(name, seed, n)
     t_wall = time.monotonic()
     result = {"schedule": name, "seed": seed, "ok": False, "events":
               [(round(t, 3), op) for t, op, _ in events]}
@@ -342,9 +356,17 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
         cluster.stop()
         result["wall_s"] = round(time.monotonic() - t_wall, 2)
         if not result["ok"]:
-            log(f"[paxchaos] schedule {name} seed {seed} FAILED — "
-                f"replay with: tools/chaos.py --schedules {name} "
-                f"--seeds {seed}")
+            if custom_events:
+                # events-override runs (paxmc replays) have no named
+                # schedule to hand to --schedules; the reproduction
+                # recipe is the plan file itself
+                log(f"[paxchaos] schedule {name} seed {seed} FAILED — "
+                    f"replay with: tools/chaos.py --plan-file "
+                    f"<the same plan/trace file> --seeds {seed}")
+            else:
+                log(f"[paxchaos] schedule {name} seed {seed} FAILED — "
+                    f"replay with: tools/chaos.py --schedules {name} "
+                    f"--seeds {seed}")
 
 
 def _stalled_during_fault(sample_t: list[float],

@@ -1,25 +1,11 @@
-"""Cluster invariant checker: what must hold no matter what chaos did.
+"""Cluster invariant checker — now a facade over the shared catalogue.
 
-Three invariants, each falsifiable from artifacts a campaign already
-has in hand (the replicas' durable-log mirrors, the frontier samples
-taken during the run, and the client's exactly-once reply book):
-
-* **Committed-slot agreement** — for every pair of replicas, every
-  slot at or below BOTH committed prefixes must hold the same command
-  (byte-level compare of op/key/val/cmd_id/client_id via
-  ``StableStore.read_range``; ballot and status legitimately differ —
-  a follower may hold the value as a superseded-ballot accept). A
-  single disagreeing slot is a consensus safety violation, full stop.
-* **Frontier monotonicity** — each replica's committed frontier, as
-  sampled over the campaign, never decreases (the runtime also dlogs
-  this live; the checker makes it a verdict).
-* **Per-key linearizable history** — replay the committed log in slot
-  order; every acked GET's reply value must equal the replayed value
-  of its key at (one of) that command's committed slot(s). A failover
-  re-propose can legitimately commit a command twice (client-side
-  cmd_id dedup is the exactly-once mechanism, as in the reference),
-  so the reply must match at least one occurrence — what can NOT
-  happen is a reply value no serialization of the log explains.
+The predicates themselves live in :mod:`minpaxos_tpu.verify.invariants`
+(extracted in the paxmc PR), so the bounded model checker and the chaos
+campaigns certify byte-for-byte the same properties; this module keeps
+the historical ``chaos.check`` import path alive for existing callers
+and docs. See verify/invariants.py for the invariant catalogue and the
+slot-record contract, VERIFY.md for the two-prover design.
 
 The checker runs against a QUIESCED cluster (load stopped, chaos
 healed, frontiers converged): the campaign runner guarantees that
@@ -29,164 +15,14 @@ race the protocol threads.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from minpaxos_tpu.verify.invariants import (  # noqa: F401
+    CheckReport,
+    VALUE_FIELDS as _VALUE_FIELDS,
+    check_cluster,
+    check_frontier_monotonic,
+    check_linearizable,
+    check_log_agreement,
+)
 
-import numpy as np
-
-from minpaxos_tpu.wire.messages import Op
-
-#: fields whose byte-level agreement IS the safety invariant
-_VALUE_FIELDS = ("op", "key", "val", "cmd_id", "client_id")
-
-
-@dataclass
-class CheckReport:
-    ok: bool = True
-    violations: list[str] = field(default_factory=list)
-    compared_slots: int = 0
-    replayed_slots: int = 0
-    checked_gets: int = 0
-    frontiers: dict[int, int] = field(default_factory=dict)
-
-    def add(self, msg: str) -> None:
-        self.ok = False
-        self.violations.append(msg)
-
-    def to_dict(self) -> dict:
-        return {"ok": self.ok, "violations": self.violations,
-                "compared_slots": self.compared_slots,
-                "replayed_slots": self.replayed_slots,
-                "checked_gets": self.checked_gets,
-                "frontiers": {str(k): v for k, v in self.frontiers.items()}}
-
-
-def check_log_agreement(stores: dict[int, "StableStore"],
-                        report: CheckReport) -> None:
-    """Pairwise byte-level cross-check of the committed prefixes."""
-    ids = sorted(stores)
-    recs = {}
-    for rid in ids:
-        prefix = stores[rid].committed_prefix()
-        report.frontiers[rid] = prefix
-        recs[rid] = stores[rid].read_range(0, prefix)  # empty if < 0
-    for i, a in enumerate(ids):
-        for b in ids[i + 1:]:
-            lo_pref = min(report.frontiers[a], report.frontiers[b])
-            if lo_pref < 0:
-                continue
-            ra = recs[a][recs[a]["inst"] <= lo_pref]
-            rb = recs[b][recs[b]["inst"] <= lo_pref]
-            # align by inst: both prefixes are record-complete by
-            # definition of committed_prefix, so the insts must match
-            common, ia, ib = np.intersect1d(ra["inst"], rb["inst"],
-                                            return_indices=True)
-            if len(common) != lo_pref + 1:
-                report.add(
-                    f"replicas {a}/{b}: committed prefixes claim "
-                    f"{lo_pref + 1} slots but only {len(common)} "
-                    f"records are present on both")
-            for f in _VALUE_FIELDS:
-                bad = np.nonzero(ra[f][ia] != rb[f][ib])[0]
-                if bad.size:
-                    s = int(common[bad[0]])
-                    report.add(
-                        f"COMMITTED-SLOT DIVERGENCE replicas {a}/{b} "
-                        f"slot {s} field {f}: "
-                        f"{ra[ia[bad[0]]]!r} vs {rb[ib[bad[0]]]!r} "
-                        f"(+{bad.size - 1} more)")
-                    break
-            report.compared_slots += len(common)
-
-
-def check_frontier_monotonic(samples: dict[int, list[int]],
-                             report: CheckReport) -> None:
-    """``samples[rid]`` = that replica's frontier, sampled in time
-    order during the campaign."""
-    for rid, seq in sorted(samples.items()):
-        arr = np.asarray(seq)
-        if arr.size < 2:
-            continue
-        drops = np.nonzero(np.diff(arr) < 0)[0]
-        if drops.size:
-            i = int(drops[0])
-            report.add(f"replica {rid}: frontier went BACKWARD at "
-                       f"sample {i + 1}: {int(arr[i])} -> "
-                       f"{int(arr[i + 1])}")
-
-
-def check_linearizable(store: "StableStore", replies: dict[int, dict],
-                       ops: np.ndarray, keys: np.ndarray,
-                       vals: np.ndarray, report: CheckReport) -> None:
-    """Replay the committed prefix of ``store`` (the most advanced
-    replica) in slot order and hold the client's history to it:
-
-    * every acked command (cmd_id in ``replies``) must appear in the
-      committed log — an acked-but-never-committed write is data loss;
-    * every acked GET's reply value must match the replayed value of
-      its key at some committed occurrence of that GET;
-    * every committed occurrence of a PUT must carry the workload's
-      (key, val) for that cmd_id — the log cannot invent writes.
-
-    ``ops/keys/vals`` are the workload arrays (cmd_id == index), the
-    same exactly-once bookkeeping the ``-check`` client mode uses.
-    """
-    prefix = store.committed_prefix()
-    if prefix < 0:
-        return
-    rec = store.read_range(0, prefix)
-    report.replayed_slots += len(rec)
-    acked = {int(c) for c in replies}
-    seen: set[int] = set()
-    kv: dict[int, int] = {}
-    get_ok: set[int] = set()
-    get_bad: dict[int, tuple[int, int]] = {}
-    for j in range(len(rec)):
-        cid = int(rec["client_id"][j])
-        cmd = int(rec["cmd_id"][j])
-        op = int(rec["op"][j])
-        key = int(rec["key"][j])
-        if cid < 0 or op == int(Op.NONE):
-            continue  # no-op fill (takeover / gap heal)
-        if cmd < len(ops):
-            if int(ops[cmd]) != op or int(keys[cmd]) != key or (
-                    op == int(Op.PUT) and int(vals[cmd]) != int(rec["val"][j])):
-                report.add(
-                    f"slot {int(rec['inst'][j])}: committed command "
-                    f"(cmd {cmd}, op {op}, key {key}) does not match "
-                    f"the workload's cmd {cmd}")
-            seen.add(cmd)
-        if op == int(Op.PUT):
-            kv[key] = int(rec["val"][j])
-        elif op == int(Op.GET) and cmd in acked and cmd not in get_ok:
-            want = kv.get(key, 0)
-            got = replies[cmd].get("val")
-            if got == want:
-                get_ok.add(cmd)
-                get_bad.pop(cmd, None)
-            else:
-                get_bad[cmd] = (got, want)
-    for cmd, (got, want) in sorted(get_bad.items())[:5]:
-        report.add(f"GET cmd {cmd}: reply value {got} matches no "
-                   f"committed occurrence (last replayed value {want})")
-    report.checked_gets += len(get_ok) + len(get_bad)
-    lost = sorted(acked - seen)
-    if lost:
-        report.add(f"{len(lost)} acked command(s) absent from the "
-                   f"committed log (first: cmd {lost[0]}) — acked "
-                   f"write lost")
-
-
-def check_cluster(stores: dict[int, "StableStore"],
-                  frontier_samples: dict[int, list[int]] | None = None,
-                  replies: dict[int, dict] | None = None,
-                  workload: tuple | None = None) -> CheckReport:
-    """Run every invariant that the provided artifacts allow."""
-    report = CheckReport()
-    check_log_agreement(stores, report)
-    if frontier_samples:
-        check_frontier_monotonic(frontier_samples, report)
-    if replies is not None and workload is not None:
-        best = max(stores, key=lambda r: stores[r].committed_prefix())
-        ops, keys, vals = workload
-        check_linearizable(stores[best], replies, ops, keys, vals, report)
-    return report
+__all__ = ["CheckReport", "check_cluster", "check_frontier_monotonic",
+           "check_linearizable", "check_log_agreement"]

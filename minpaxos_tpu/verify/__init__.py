@@ -1,0 +1,48 @@
+"""paxmc — machine-checked verification of the consensus kernels.
+
+The reference codebase ships a 718-line TLA+ spec because Paxos safety
+bugs hide in interleavings no test reaches. This package closes the
+same gap for the *compiled* protocol logic, from two directions:
+
+* :mod:`minpaxos_tpu.verify.invariants` — the safety predicates
+  (committed-slot agreement, validity, frontier monotonicity,
+  per-key linearizable history) as plain-numpy functions. Both the
+  bounded model checker and the paxchaos campaigns
+  (:mod:`minpaxos_tpu.chaos.check`) call these exact functions, so a
+  property certified by exhaustive exploration is byte-for-byte the
+  property chaos probes on live TCP clusters.
+* :mod:`minpaxos_tpu.verify.quorum` — static quorum-intersection
+  certificates: proofs (or refutations, with explicit witness sets)
+  that a (N, q1, q2) threshold or grid quorum system intersects. The
+  certified entries live in the append-only ledger
+  ``minpaxos_tpu/analysis/quorum_golden.py``, and the paxlint
+  ``quorum-certificate`` pass holds every quorum-threshold expression
+  in ``ops/`` and ``models/`` to it.
+* :mod:`minpaxos_tpu.verify.mc` — the bounded model checker itself
+  (imports JAX; import it explicitly, not via this package, so the
+  static layers stay usable from paxlint without a JAX boot).
+
+CLI: ``tools/mc.py`` (``--smoke`` is the tier-1 gate). Docs:
+VERIFY.md at the repo root.
+"""
+
+from minpaxos_tpu.verify.invariants import (  # noqa: F401
+    CheckReport,
+    check_cluster,
+    check_frontier_monotonic,
+    check_linearizable,
+    check_log_agreement,
+    check_slot_agreement,
+    check_validity,
+)
+from minpaxos_tpu.verify.quorum import (  # noqa: F401
+    Certificate,
+    certify_grid,
+    certify_threshold,
+)
+
+__all__ = [
+    "CheckReport", "check_cluster", "check_frontier_monotonic",
+    "check_linearizable", "check_log_agreement", "check_slot_agreement",
+    "check_validity", "Certificate", "certify_grid", "certify_threshold",
+]

@@ -1,0 +1,284 @@
+"""The safety invariant catalogue — ONE implementation, two provers.
+
+What must hold no matter what the network, the scheduler, or a fault
+plan did. Extracted from ``chaos/check.py`` so the bounded model
+checker (``verify/mc.py``) and the chaos campaigns
+(``chaos/campaign.py``) certify literally the same predicates: a
+counterexample the checker finds is an input the chaos checker would
+flag, and vice versa — static analysis and chaos confirming each
+other instead of drifting apart.
+
+Record contract: every prover reduces its artifacts to *slot records*
+— numpy structured arrays carrying at least ``inst`` plus the
+``VALUE_FIELDS`` (``op``/``key``/``val``/``cmd_id``/``client_id``,
+the byte-level identity of a committed command). ``StableStore``'s
+mirror rows (``runtime/stable.py SLOT_DT``) already have this shape;
+the model checker builds the same shape from resident window arrays
+(``make_records``).
+
+Invariants:
+
+* **Committed-slot agreement** — for every pair of replicas, every
+  slot at or below BOTH committed frontiers holds the same command
+  (ballot and status legitimately differ — a follower may hold the
+  value as a superseded-ballot accept). One disagreeing slot is a
+  consensus safety violation, full stop.
+* **Validity** — every committed command was actually proposed (its
+  cmd_id's op/key/val match the workload table) or is an explicit
+  no-op fill (gap heal / Mencius skip). A log cannot invent writes.
+* **Frontier monotonicity** — a replica's committed frontier, sampled
+  in time order, never decreases.
+* **Per-key linearizable history** — replay the committed log in slot
+  order; every acked GET's reply matches the replayed value of its
+  key at some committed occurrence, and every acked command appears
+  in the log (an acked-but-never-committed write is data loss).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from minpaxos_tpu.wire.messages import Op
+
+#: fields whose byte-level agreement IS the safety invariant
+VALUE_FIELDS = ("op", "key", "val", "cmd_id", "client_id")
+
+#: the minimal slot-record dtype (StableStore's SLOT_DT is a superset;
+#: equality is checked field-by-name so extra fields are harmless)
+SLOT_RECORD = np.dtype([
+    ("inst", "<i4"), ("op", "u1"), ("key", "<i8"), ("val", "<i8"),
+    ("cmd_id", "<i4"), ("client_id", "<i4"),
+])
+
+
+@dataclass
+class CheckReport:
+    ok: bool = True
+    violations: list[str] = field(default_factory=list)
+    compared_slots: int = 0
+    replayed_slots: int = 0
+    checked_gets: int = 0
+    frontiers: dict[int, int] = field(default_factory=dict)
+
+    def add(self, msg: str) -> None:
+        self.ok = False
+        self.violations.append(msg)
+
+    def to_dict(self) -> dict:
+        return {"ok": self.ok, "violations": self.violations,
+                "compared_slots": self.compared_slots,
+                "replayed_slots": self.replayed_slots,
+                "checked_gets": self.checked_gets,
+                "frontiers": {str(k): v for k, v in self.frontiers.items()}}
+
+
+def make_records(insts, ops, keys, vals, cmd_ids, client_ids) -> np.ndarray:
+    """Build slot records from parallel columns (the model checker's
+    window-array path; chaos feeds StableStore mirrors directly)."""
+    rec = np.zeros(len(np.atleast_1d(insts)), SLOT_RECORD)
+    for name, col in zip(("inst",) + VALUE_FIELDS,
+                         (insts, ops, keys, vals, cmd_ids, client_ids)):
+        rec[name] = np.atleast_1d(col)
+    return rec
+
+
+# ------------------------------------------------- committed agreement
+
+def check_slot_agreement(records: dict[int, np.ndarray],
+                         frontiers: dict[int, int],
+                         report: CheckReport) -> None:
+    """Pairwise byte-level cross-check of committed prefixes.
+
+    ``records[rid]``: slot records for every slot replica ``rid`` holds
+    committed at inst <= ``frontiers[rid]``; prefixes are expected to be
+    record-complete (a missing slot below both frontiers is itself a
+    violation — a committed slot a replica cannot produce is a hole).
+    """
+    ids = sorted(records)
+    report.frontiers.update({r: int(frontiers[r]) for r in ids})
+    for i, a in enumerate(ids):
+        for b in ids[i + 1:]:
+            lo_pref = min(frontiers[a], frontiers[b])
+            if lo_pref < 0:
+                continue
+            ra = records[a][records[a]["inst"] <= lo_pref]
+            rb = records[b][records[b]["inst"] <= lo_pref]
+            # align by inst: both prefixes are record-complete by
+            # definition of committed_prefix, so the insts must match
+            common, ia, ib = np.intersect1d(ra["inst"], rb["inst"],
+                                            return_indices=True)
+            if len(common) != lo_pref + 1:
+                report.add(
+                    f"replicas {a}/{b}: committed prefixes claim "
+                    f"{lo_pref + 1} slots but only {len(common)} "
+                    f"records are present on both")
+            for f in VALUE_FIELDS:
+                bad = np.nonzero(ra[f][ia] != rb[f][ib])[0]
+                if bad.size:
+                    s = int(common[bad[0]])
+                    report.add(
+                        f"COMMITTED-SLOT DIVERGENCE replicas {a}/{b} "
+                        f"slot {s} field {f}: "
+                        f"{ra[ia[bad[0]]]!r} vs {rb[ib[bad[0]]]!r} "
+                        f"(+{bad.size - 1} more)")
+                    break
+            report.compared_slots += len(common)
+
+
+def check_log_agreement(stores: dict[int, "StableStore"],
+                        report: CheckReport) -> None:
+    """Agreement over durable-log mirrors (the chaos prover's path):
+    reduce each store to slot records, then run the shared predicate."""
+    frontiers = {rid: stores[rid].committed_prefix() for rid in stores}
+    records = {rid: stores[rid].read_range(0, frontiers[rid])
+               for rid in stores}
+    check_slot_agreement(records, frontiers, report)
+
+
+# ------------------------------------------------------------ validity
+
+def check_validity(records: np.ndarray, ops: np.ndarray, keys: np.ndarray,
+                   vals: np.ndarray, report: CheckReport,
+                   who: str = "") -> None:
+    """Every committed command was proposed or is an explicit no-op.
+
+    ``ops/keys/vals`` are the workload table (cmd_id == index). No-op
+    fills (op == NONE, or client_id < 0 — takeover / gap heal / Mencius
+    skip) are exempt: they carry no client command by design.
+    """
+    tag = f"{who}: " if who else ""
+    for j in range(len(records)):
+        op = int(records["op"][j])
+        cid = int(records["client_id"][j])
+        cmd = int(records["cmd_id"][j])
+        if cid < 0 or op == int(Op.NONE):
+            continue
+        if not 0 <= cmd < len(ops):
+            report.add(f"{tag}slot {int(records['inst'][j])}: committed "
+                       f"cmd_id {cmd} was never proposed (workload has "
+                       f"{len(ops)} commands) — the log invented a write")
+            continue
+        if (int(ops[cmd]) != op or int(keys[cmd]) != int(records["key"][j])
+                or (op == int(Op.PUT)
+                    and int(vals[cmd]) != int(records["val"][j]))):
+            report.add(
+                f"{tag}slot {int(records['inst'][j])}: committed command "
+                f"(cmd {cmd}, op {op}, key {int(records['key'][j])}) does "
+                f"not match the workload's cmd {cmd}")
+
+
+# ------------------------------------------------- frontier monotonic
+
+def check_frontier_monotonic(samples: dict[int, list[int]],
+                             report: CheckReport) -> None:
+    """``samples[rid]`` = that replica's frontier, sampled in time
+    order (chaos: wall-clock sampler; model checker: pre/post step)."""
+    for rid, seq in sorted(samples.items()):
+        arr = np.asarray(seq)
+        if arr.size < 2:
+            continue
+        drops = np.nonzero(np.diff(arr) < 0)[0]
+        if drops.size:
+            i = int(drops[0])
+            report.add(f"replica {rid}: frontier went BACKWARD at "
+                       f"sample {i + 1}: {int(arr[i])} -> "
+                       f"{int(arr[i + 1])}")
+
+
+# -------------------------------------------------- linearizability
+
+def check_linearizable(store: "StableStore", replies: dict[int, dict],
+                       ops: np.ndarray, keys: np.ndarray,
+                       vals: np.ndarray, report: CheckReport) -> None:
+    """Replay the committed prefix of ``store`` (the most advanced
+    replica) in slot order and hold the client's history to it:
+
+    * every acked command (cmd_id in ``replies``) must appear in the
+      committed log — an acked-but-never-committed write is data loss;
+    * every acked GET's reply value must match the replayed value of
+      its key at some committed occurrence of that GET (a failover
+      re-propose can legitimately commit a command twice; client-side
+      cmd_id dedup is the exactly-once mechanism — what can NOT happen
+      is a reply value no serialization of the log explains);
+    * every committed occurrence of a PUT must carry the workload's
+      (key, val) for that cmd_id — the log cannot invent writes.
+
+    ``ops/keys/vals`` are the workload arrays (cmd_id == index), the
+    same exactly-once bookkeeping the ``-check`` client mode uses.
+    """
+    prefix = store.committed_prefix()
+    if prefix < 0:
+        return
+    rec = store.read_range(0, prefix)
+    report.replayed_slots += len(rec)
+    acked = {int(c) for c in replies}
+    seen: set[int] = set()
+    kv: dict[int, int] = {}
+    get_ok: set[int] = set()
+    get_bad: dict[int, tuple[int, int]] = {}
+    for j in range(len(rec)):
+        cid = int(rec["client_id"][j])
+        cmd = int(rec["cmd_id"][j])
+        op = int(rec["op"][j])
+        key = int(rec["key"][j])
+        if cid < 0 or op == int(Op.NONE):
+            continue  # no-op fill (takeover / gap heal)
+        if cmd < len(ops):
+            if int(ops[cmd]) != op or int(keys[cmd]) != key or (
+                    op == int(Op.PUT) and int(vals[cmd]) != int(rec["val"][j])):
+                report.add(
+                    f"slot {int(rec['inst'][j])}: committed command "
+                    f"(cmd {cmd}, op {op}, key {key}) does not match "
+                    f"the workload's cmd {cmd}")
+            seen.add(cmd)
+        if op == int(Op.PUT):
+            kv[key] = int(rec["val"][j])
+        elif op == int(Op.GET) and cmd in acked and cmd not in get_ok:
+            want = kv.get(key, 0)
+            got = replies[cmd].get("val")
+            if got == want:
+                get_ok.add(cmd)
+                get_bad.pop(cmd, None)
+            else:
+                get_bad[cmd] = (got, want)
+    for cmd, (got, want) in sorted(get_bad.items())[:5]:
+        report.add(f"GET cmd {cmd}: reply value {got} matches no "
+                   f"committed occurrence (last replayed value {want})")
+    report.checked_gets += len(get_ok) + len(get_bad)
+    lost = sorted(acked - seen)
+    if lost:
+        report.add(f"{len(lost)} acked command(s) absent from the "
+                   f"committed log (first: cmd {lost[0]}) — acked "
+                   f"write lost")
+
+
+# ----------------------------------------------------- the full suite
+
+def check_cluster(stores: dict[int, "StableStore"],
+                  frontier_samples: dict[int, list[int]] | None = None,
+                  replies: dict[int, dict] | None = None,
+                  workload: tuple | None = None) -> CheckReport:
+    """Run every invariant that the provided artifacts allow (the
+    chaos campaign's entry point; ``verify/mc.py`` calls the
+    predicates piecemeal on model states instead)."""
+    report = CheckReport()
+    check_log_agreement(stores, report)
+    if frontier_samples:
+        check_frontier_monotonic(frontier_samples, report)
+    if workload is not None:
+        ops, keys, vals = workload
+        # validity over EVERY replica's committed prefix — the same
+        # predicate the model checker runs per state; an invented
+        # write (cmd_id outside the workload) must fail the chaos
+        # prover exactly like it fails the bounded exploration
+        for rid in sorted(stores):
+            rec = stores[rid].read_range(0, stores[rid].committed_prefix())
+            check_validity(rec, ops, keys, vals, report,
+                           who=f"replica {rid}")
+        if replies is not None:
+            best = max(stores, key=lambda r: stores[r].committed_prefix())
+            check_linearizable(stores[best], replies, ops, keys, vals,
+                               report)
+    return report

@@ -1,0 +1,185 @@
+"""Static quorum-intersection certificates: prove it or show the split.
+
+Paxos safety reduces to one set-theoretic fact: every phase-1 quorum
+must intersect every phase-2 quorum (Flexible Paxos, PAPERS.md
+1608.06696 — plain Paxos is the q1 == q2 == majority special case;
+Fast Flexible Paxos 2008.02671 adds structured systems like grids).
+In the vectorized kernels a quorum is nothing but a threshold in a
+majority-mask compare (``n_votes >= majority``), which is exactly why
+a non-intersecting (q1, q2) can slip in silently: the kernel compiles,
+every test with a healthy network passes, and the first asymmetric
+partition commits two different values for one slot.
+
+This module makes the property a *certificate* — a small, checkable
+object that either proves intersection or refutes it with an explicit
+witness pair of disjoint quorums:
+
+* **threshold systems** (N replicas, any q1 acceptors for phase 1, any
+  q2 for phase 2): intersect iff q1 + q2 > N (pigeonhole); refutations
+  carry the canonical disjoint pair A = {0..q1-1}, B = {N-q2..N-1}.
+* **grid systems** (rows x cols cells, one replica per cell): phase-1
+  quorum = all cells of one row, phase-2 = all cells of one column (or
+  any row/col assignment per phase). Row-vs-column intersects at the
+  crossing cell; same-axis assignments are refuted by two parallel
+  lines.
+
+``verify_certificate`` re-derives every certificate from scratch —
+refutations by checking the witness, proofs by exhaustive enumeration
+for small N and by the pigeonhole inequality beyond — so the ledger
+(``minpaxos_tpu/analysis/quorum_golden.py``) cannot go stale: the
+paxlint ``quorum-certificate`` pass re-verifies each entry on every
+lint run, and flags any quorum threshold in ``ops/``/``models/`` not
+covered by a certified entry. Pure stdlib on purpose: paxlint imports
+this without booting JAX.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+from itertools import combinations
+
+#: the ballot encoding (models/minpaxos.py make_ballot) caps replicas
+#: at 16, so certifying N in [1, 16] covers every runnable config
+MAX_N = 16
+
+#: proofs for N <= this bound are re-verified by brute enumeration of
+#: every (Q1, Q2) pair rather than trusted to the arithmetic argument
+EXHAUSTIVE_N = 10
+
+
+@dataclass(frozen=True)
+class Certificate:
+    """One (quorum system, q1, q2) intersection verdict.
+
+    ``witness`` is ``None`` for proofs; for refutations it is a pair of
+    concrete disjoint quorums (tuples of replica ids) — the seed of a
+    counterexample schedule (partition the witness sets apart and each
+    side can assemble its quorum without the other).
+    """
+
+    system: str  # "threshold" | "grid"
+    n: int  # total replicas
+    q1: object  # threshold int, or "row"/"col" for grids
+    q2: object
+    intersects: bool
+    reason: str
+    witness: tuple | None = None
+    rows: int = 0  # grid shape (0 for threshold systems)
+    cols: int = 0
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        if self.witness is not None:
+            d["witness"] = [sorted(self.witness[0]), sorted(self.witness[1])]
+        return d
+
+
+def certify_threshold(n: int, q1: int, q2: int) -> Certificate:
+    """Prove or refute intersection for the (n, q1, q2) threshold
+    system. Degenerate thresholds (q < 1 or q > n: no such quorum can
+    ever assemble, so the protocol is vacuously safe and totally live-
+    less) are REFUSED rather than certified either way."""
+    if not (1 <= q1 <= n and 1 <= q2 <= n):
+        raise ValueError(
+            f"degenerate quorum thresholds for n={n}: q1={q1}, q2={q2} "
+            f"(must satisfy 1 <= q <= n)")
+    if q1 + q2 > n:
+        return Certificate(
+            "threshold", n, q1, q2, True,
+            f"pigeonhole: |Q1 ∩ Q2| >= q1 + q2 - n = {q1 + q2 - n} >= 1 "
+            f"for every Q1, Q2")
+    a = tuple(range(q1))
+    b = tuple(range(n - q2, n))
+    return Certificate(
+        "threshold", n, q1, q2, False,
+        f"q1 + q2 = {q1 + q2} <= n = {n}: disjoint quorums exist",
+        witness=(a, b))
+
+
+def certify_grid(rows: int, cols: int, q1: str = "row",
+                 q2: str = "col") -> Certificate:
+    """Prove or refute intersection for a rows x cols grid system
+    where a phase-p quorum is all cells of one row (``"row"``) or one
+    column (``"col"``). Cell (r, c) is replica r * cols + c."""
+    if rows < 1 or cols < 1:
+        raise ValueError(f"grid must be at least 1x1: {rows}x{cols}")
+    if q1 not in ("row", "col") or q2 not in ("row", "col"):
+        raise ValueError(f"grid quorum axes must be row/col: {q1}, {q2}")
+    n = rows * cols
+
+    def line(axis: str, i: int) -> tuple[int, ...]:
+        if axis == "row":
+            return tuple(i * cols + c for c in range(cols))
+        return tuple(r * cols + i for r in range(rows))
+
+    if q1 != q2:
+        return Certificate(
+            "grid", n, q1, q2, True,
+            f"every {q1} meets every {q2} at exactly one cell of the "
+            f"{rows}x{cols} grid", rows=rows, cols=cols)
+    count = rows if q1 == "row" else cols
+    if count == 1:
+        return Certificate(
+            "grid", n, q1, q2, True,
+            f"only one {q1} exists in a {rows}x{cols} grid: every "
+            f"quorum is the same set", rows=rows, cols=cols)
+    return Certificate(
+        "grid", n, q1, q2, False,
+        f"two parallel {q1}s of a {rows}x{cols} grid are disjoint",
+        witness=(line(q1, 0), line(q1, 1)), rows=rows, cols=cols)
+
+
+def _grid_lines(cert: Certificate, axis: str) -> list[tuple[int, ...]]:
+    if axis == "row":
+        return [tuple(r * cert.cols + c for c in range(cert.cols))
+                for r in range(cert.rows)]
+    return [tuple(r * cert.cols + c for r in range(cert.rows))
+            for c in range(cert.cols)]
+
+
+def verify_certificate(cert: Certificate) -> bool:
+    """Re-derive a certificate from scratch (no trust in ``reason``):
+
+    * refutations: the witness must be two valid, disjoint quorums;
+    * threshold proofs: exhaustive over every (Q1, Q2) pair for
+      n <= EXHAUSTIVE_N, the pigeonhole inequality beyond;
+    * grid proofs: exhaustive over every line pair (grids are tiny).
+    """
+    if cert.system == "threshold":
+        n, q1, q2 = cert.n, cert.q1, cert.q2
+        if not (isinstance(q1, int) and isinstance(q2, int)
+                and 1 <= q1 <= n and 1 <= q2 <= n):
+            return False
+        if not cert.intersects:
+            if cert.witness is None:
+                return False
+            a, b = (frozenset(cert.witness[0]), frozenset(cert.witness[1]))
+            universe = frozenset(range(n))
+            return (len(a) == q1 and len(b) == q2 and a <= universe
+                    and b <= universe and not (a & b))
+        if n <= EXHAUSTIVE_N:
+            ids = range(n)
+            return all(set(qa) & set(qb)
+                       for qa in combinations(ids, q1)
+                       for qb in combinations(ids, q2))
+        return q1 + q2 > n
+    if cert.system == "grid":
+        if cert.rows * cert.cols != cert.n:
+            return False
+        if not cert.intersects:
+            if cert.witness is None or cert.q1 != cert.q2:
+                return False
+            lines = _grid_lines(cert, cert.q1)
+            a, b = (frozenset(cert.witness[0]), frozenset(cert.witness[1]))
+            return (a in map(frozenset, lines) and b in map(frozenset, lines)
+                    and not (a & b))
+        return all(set(qa) & set(qb)
+                   for qa in _grid_lines(cert, cert.q1)
+                   for qb in _grid_lines(cert, cert.q2))
+    return False
+
+
+def majority(n: int) -> int:
+    """The threshold actually compiled into the kernels
+    (``MinPaxosConfig.majority``): q = n // 2 + 1, both phases."""
+    return n // 2 + 1

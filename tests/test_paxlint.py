@@ -486,6 +486,255 @@ def f():
     assert lint_src("minpaxos_tpu/runtime/x.py", src, "broad-except") == []
 
 
+# ---------------------------------------------------- quorum-certificate
+
+
+QUORUM_BAD = '''
+class FlexCfg:
+    @property
+    def q1(self):
+        return (self.n_replicas + 1) // 2
+
+    @property
+    def q2(self):
+        return (self.n_replicas + 1) // 2
+'''
+
+QUORUM_CLEAN = '''
+class Cfg:
+    @property
+    def majority(self):
+        return self.n_replicas // 2 + 1
+
+
+def step(cfg, state, n_votes):
+    majority = cfg.majority          # delegation: certified at source
+    return n_votes >= majority
+'''
+
+
+def test_quorum_certificate_rejects_non_intersecting_pair():
+    vs = lint_src("minpaxos_tpu/models/flex.py", QUORUM_BAD,
+                  "quorum-certificate")
+    assert any("NON-INTERSECTING" in v.msg for v in vs), vs
+    # the refutation names a concrete disjoint witness pair
+    assert any("disjoint quorums" in v.msg for v in vs), vs
+
+
+def test_quorum_certificate_quiet_on_certified_majority():
+    assert lint_src("minpaxos_tpu/models/ok.py", QUORUM_CLEAN,
+                    "quorum-certificate") == []
+
+
+def test_quorum_certificate_flags_uncovered_and_literal():
+    # intersecting but absent from the ledger: must be appended
+    src = ("class C:\n    @property\n    def quorum(self):\n"
+           "        return self.n_replicas - 0\n")  # q = n: intersects
+    vs = lint_src("minpaxos_tpu/models/u.py", src, "quorum-certificate")
+    assert any("not covered by a certified entry" in v.msg for v in vs), vs
+    # fixed literal compared against a vote count
+    lit = "def f(state):\n    return state.n_votes >= 1\n"
+    vs = lint_src("minpaxos_tpu/ops/l.py", lit, "quorum-certificate")
+    assert any("fixed literal" in v.msg for v in vs), vs
+
+
+def test_quorum_certificate_unrecognizable_formula_flagged():
+    src = ("class C:\n    @property\n    def majority(self):\n"
+           "        return mystery()\n")
+    vs = lint_src("minpaxos_tpu/models/m.py", src, "quorum-certificate")
+    assert any("cannot certify" in v.msg for v in vs), vs
+
+
+def test_quorum_certificate_scoped_to_device_packages():
+    # the same bad pair outside ops//models/ is out of scope
+    assert lint_src("minpaxos_tpu/runtime/flex.py", QUORUM_BAD,
+                    "quorum-certificate") == []
+
+
+# ------------------------------------------------------------ lock-order
+
+
+LOCK_CYCLE = '''
+import threading
+
+class Transport:
+    def __init__(self):
+        self._peers_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def send(self):
+        with self._peers_lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._stats_lock:
+            self._count()
+
+    def _count(self):
+        with self._peers_lock:
+            pass
+'''
+
+LOCK_ORDERED = '''
+import threading
+
+class Transport:
+    def __init__(self):
+        self._peers_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def send(self):
+        with self._peers_lock:
+            with self._stats_lock:
+                pass
+
+    def report(self):
+        with self._peers_lock:          # same order everywhere
+            with self._stats_lock:
+                self._count()
+
+    def _count(self):
+        pass
+'''
+
+LOCK_CROSS = '''
+import threading
+
+class Transport:
+    def __init__(self, master):
+        self._lock = threading.Lock()
+        self.master = Master()
+
+    def send(self):
+        with self._lock:
+            pass
+
+    def deliver(self):
+        with self._lock:
+            self.master.on_frame()
+
+class Master:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.transport = Transport(self)
+
+    def on_frame(self):
+        with self._lock:
+            pass
+
+    def fanout(self):
+        with self._lock:
+            self.transport.send()
+'''
+
+
+def test_lock_order_cycle_fires():
+    vs = lint_src("minpaxos_tpu/runtime/transport.py", LOCK_CYCLE,
+                  "lock-order")
+    assert len(vs) == 1 and "lock-order cycle" in vs[0].msg, vs
+    assert "_peers_lock" in vs[0].msg and "_stats_lock" in vs[0].msg
+
+
+def test_lock_order_quiet_on_consistent_order():
+    assert lint_src("minpaxos_tpu/runtime/transport.py", LOCK_ORDERED,
+                    "lock-order") == []
+
+
+def test_lock_order_cross_class_cycle_fires():
+    """The production shape: master holds its lock fanning out through
+    transport methods that take the transport lock, while a transport
+    read loop holds its lock calling back into the master."""
+    vs = lint_src("minpaxos_tpu/runtime/master.py", LOCK_CROSS,
+                  "lock-order")
+    assert len(vs) == 1, vs
+    assert "Transport._lock" in vs[0].msg and "Master._lock" in vs[0].msg
+
+
+def test_lock_order_nested_inside_branches_tracked():
+    # the with->if->with nesting must still build the edge
+    src = LOCK_CYCLE.replace(
+        "        with self._stats_lock:\n            self._count()",
+        "        with self._stats_lock:\n"
+        "            if True:\n                self._count()")
+    vs = lint_src("minpaxos_tpu/runtime/transport.py", src, "lock-order")
+    assert len(vs) == 1, vs
+
+
+def test_lock_order_scoped_to_runtime():
+    assert lint_src("minpaxos_tpu/cli/x.py", LOCK_CYCLE, "lock-order") == []
+
+
+def test_lock_order_sees_through_match_statements():
+    """Code-review regression: locks taken inside `match` case arms
+    (whose bodies live in match_case objects, not plain stmt bodies)
+    still build graph edges."""
+    src = LOCK_CYCLE.replace(
+        "    def report(self):\n        with self._stats_lock:\n"
+        "            self._count()",
+        "    def report(self, kind):\n        match kind:\n"
+        "            case 1:\n                with self._stats_lock:\n"
+        "                    self._count()")
+    vs = lint_src("minpaxos_tpu/runtime/transport.py", src, "lock-order")
+    assert len(vs) == 1 and "lock-order cycle" in vs[0].msg, vs
+
+
+def test_quorum_certificate_zero_literal_is_emptiness_not_quorum():
+    # `> 0` / `>= 0` against a vote count is an emptiness guard; a
+    # quorum size is always >= 1, so zero never flags
+    src = ("def f(state):\n"
+           "    a = state.n_votes > 0\n"
+           "    b = 0 < state.pv_cnt\n"
+           "    return a and b\n")
+    assert lint_src("minpaxos_tpu/ops/z.py", src,
+                    "quorum-certificate") == []
+
+
+def test_lock_order_duplicate_class_names_both_analyzed():
+    """Code-review regression: two runtime/ files each defining a class
+    with the SAME name must not shadow each other — a cycle inside
+    either one still fires, and the report qualifies the node names so
+    the two classes' locks don't merge into phantom edges."""
+    clean = LOCK_ORDERED  # class Transport, consistent order
+    vs = run_passes(Project({
+        "minpaxos_tpu/runtime/a.py": clean,
+        "minpaxos_tpu/runtime/b.py": LOCK_CYCLE,  # also class Transport
+    }), ("lock-order",))
+    assert len(vs) == 1 and vs[0].path.endswith("b.py"), vs
+    assert "b:Transport" in vs[0].msg, vs  # stem-qualified node label
+
+
+# --------------------------------------------- single-parse / shared graph
+
+
+def test_single_parse_and_one_graph_build_across_all_passes():
+    """The lint perf contract: one ast.parse per file, one structural
+    module walk per device file, ONE jit call-graph fixed point per
+    invocation — no matter how many passes consult it (trace-hazard
+    and recompile-hazard both do)."""
+    from minpaxos_tpu.analysis.jitgraph import DEVICE_PREFIXES
+
+    project = Project.from_root(REPO)
+    run_passes(project)  # every registered pass
+    n_device = sum(1 for p in project.files if p.startswith(DEVICE_PREFIXES))
+    assert project.stats["ast_parses"] == len(project.files)
+    assert project.stats["module_walks"] == n_device
+    assert project.stats["graph_builds"] == 1, project.stats
+    # a second full run re-uses everything — no new parses, no rebuild
+    run_passes(project)
+    assert project.stats["ast_parses"] == len(project.files)
+    assert project.stats["module_walks"] == n_device
+    assert project.stats["graph_builds"] == 1
+
+
+def test_passes_share_one_prefix_scope():
+    from minpaxos_tpu.analysis import recompile_hazard, trace_hazard
+    from minpaxos_tpu.analysis.jitgraph import DEVICE_PREFIXES
+
+    assert trace_hazard.GRAPH_PREFIXES is DEVICE_PREFIXES
+    assert recompile_hazard.PREFIXES is DEVICE_PREFIXES
+
+
 # ----------------------------------------------------- framework pieces
 
 
@@ -606,6 +855,8 @@ _CLI_SEEDS = {
     "broad-except": ("minpaxos_tpu/utils/seed.py",
                      "def f():\n    try:\n        g()\n"
                      "    except Exception:\n        pass\n"),
+    "quorum-certificate": ("minpaxos_tpu/models/flex.py", QUORUM_BAD),
+    "lock-order": ("minpaxos_tpu/runtime/transport.py", LOCK_CYCLE),
 }
 
 

@@ -56,6 +56,11 @@ def main(argv=None) -> int:
                    help="CI gate mode: fixed seeds "
                         f"{SMOKE_SEEDS}, schedules {SMOKE_SCHEDULES}, "
                         "60 s budget, exit nonzero on any failure")
+    p.add_argument("--plan-file", default=None, metavar="FILE",
+                   help="replay a paxmc counterexample's FaultPlan on a "
+                        "live cluster: FILE is tools/mc.py "
+                        "--emit-faultplan output (or a raw paxmc-ce-v1 "
+                        "trace, converted on the fly)")
     args = p.parse_args(argv)
 
     import jax
@@ -65,7 +70,28 @@ def main(argv=None) -> int:
 
     enable_compile_cache()
 
-    from minpaxos_tpu.chaos.campaign import SCHEDULES, run_campaign
+    from minpaxos_tpu.chaos.campaign import (
+        SCHEDULES,
+        run_campaign,
+        run_schedule,
+    )
+
+    if args.plan_file:
+        doc = json.loads(Path(args.plan_file).read_text())
+        if doc.get("format") == "paxmc-ce-v1":  # raw trace: project it
+            from minpaxos_tpu.verify.mc import counterexample_faultplan
+
+            doc = counterexample_faultplan(doc)
+        events = [tuple(e) for e in doc["events"]]
+        seed = int(args.seeds.split(",")[0])
+        r = run_schedule("mc_replay", seed, n=args.n, ops_n=args.ops,
+                         events=events)
+        print(f"[chaos] mc_replay verdict: "
+              f"{json.dumps({'ok': r['ok'], 'acked': r.get('acked'), 'faults': r.get('faults_injected'), 'check': r.get('check', {}).get('ok')})}",
+              flush=True)
+        if args.json:
+            Path(args.json).write_text(json.dumps(r, indent=1))
+        return 0 if r["ok"] else 1
 
     pairs = None
     if args.smoke:

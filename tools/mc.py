@@ -1,0 +1,310 @@
+#!/usr/bin/env python
+"""paxmc CLI — bounded model checking of the consensus kernels.
+
+    tools/mc.py                         # all 3 protocols, smoke bounds
+    tools/mc.py --smoke                 # CI gate: fixed bounds + seeded
+                                        # mutant self-test, 60 s budget,
+                                        # MC.json artifact (run_tier1.sh)
+    tools/mc.py --protocol mencius --depth 6 --cmds 2
+    tools/mc.py --mutant broken-quorum  # seeded non-intersecting quorum:
+                                        # exit 0 iff the split-brain
+                                        # counterexample IS found
+    tools/mc.py --replay tests/fixtures/mc_broken_quorum_minpaxos.json
+    tools/mc.py --emit-faultplan ce.json > plan.json
+    tools/mc.py --certify 5,4,2         # quorum certificate + ledger line
+    tools/mc.py --print-quorum-golden   # re-verified certified ledger
+
+Exit status: 0 = verified clean (or, in --mutant/--replay mode, the
+expected counterexample found/reproduced), 1 = violation, undrained
+frontier, or budget exceeded, 2 = usage error.
+
+The checker drives the REAL step functions (models/minpaxos.py,
+models/mencius.py) through every bounded interleaving of a 3-replica
+cluster — per-link FIFO delivery, drops, duplications, internal
+ticks, a concurrent second election — and holds every reached state
+to the same invariant predicates the chaos campaigns run against live
+clusters (verify/invariants.py). See VERIFY.md for the state-space
+model, the invariant catalogue, and the counterexample-replay
+workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+#: the tier-1 smoke legs: per-protocol bounds measured to drain well
+#: inside the budget on the 1-core CI host (see VERIFY.md for the
+#: state counts each leg certifies)
+SMOKE_BUDGET_S = 60.0
+
+
+def _smoke_legs():
+    from minpaxos_tpu.verify.mc import Bounds
+
+    # leg 1 (first = budget-excluded, like the chaos smoke): the full
+    # gauntlet — depth 5, one drop, one dup, a concurrent second
+    # election. Leg 2 re-runs the SAME kernel in explicit-commit mode
+    # without the election budget (that machinery is shared and was
+    # exhausted in leg 1); leg 3 gives Mencius two concurrent owners
+    # (the SKIP/cede interleavings that are its novel risk) at depth 4.
+    # Sized so legs 2+3+mutant stay well under the budget even at the
+    # 1-core host's slow-tide speeds (VERIFY.md has measured counts).
+    minpaxos = Bounds(max_depth=5, drops=1, dups=1, internal=1,
+                      elections=1, electable=(1,), n_cmds=2,
+                      propose_to=(0,))
+    classic = Bounds(max_depth=5, drops=1, dups=1, internal=1,
+                     elections=0, n_cmds=2, propose_to=(0,))
+    mencius = Bounds(max_depth=4, drops=1, dups=1, internal=1,
+                     elections=0, n_cmds=1, propose_to=(0, 1))
+    return [("minpaxos", minpaxos, None), ("classic", classic, None),
+            ("mencius", mencius, None)]
+
+
+def _mutant_bounds():
+    from minpaxos_tpu.verify.mc import Bounds
+
+    # two drops + both ingress queues: enough schedule freedom for the
+    # two-leaders split-brain to appear within depth 6
+    return Bounds(max_depth=6, drops=2, dups=0, internal=1, elections=1,
+                  electable=(1,), n_cmds=2, propose_to=(0, 1))
+
+
+def _print_quorum_golden() -> int:
+    """Re-verify and emit the certified ledger (the quorum twin of
+    ``lint.py --print-wire-golden``)."""
+    from minpaxos_tpu.analysis.quorum_golden import (
+        GOLDEN_GRIDS, GOLDEN_MAX_N, GOLDEN_THRESHOLDS)
+    from minpaxos_tpu.verify.quorum import (
+        certify_grid, certify_threshold, verify_certificate)
+
+    bad = 0
+    print("GOLDEN_THRESHOLDS: dict[int, tuple[tuple[int, int], ...]] = {")
+    for n in range(1, GOLDEN_MAX_N + 1):
+        pairs = GOLDEN_THRESHOLDS.get(n, ())
+        verified = []
+        for q1, q2 in pairs:
+            cert = certify_threshold(n, q1, q2)
+            if cert.intersects and verify_certificate(cert):
+                verified.append((q1, q2))
+            else:
+                bad += 1
+                print(f"    # DROPPED (fails to prove): ({q1}, {q2})")
+        print(f"    {n}: {tuple(verified)!r},")
+    print("}")
+    print("GOLDEN_GRIDS = (")
+    for rows, cols, q1, q2 in GOLDEN_GRIDS:
+        cert = certify_grid(rows, cols, q1, q2)
+        if cert.intersects and verify_certificate(cert):
+            print(f"    ({rows}, {cols}, {q1!r}, {q2!r}),")
+        else:
+            bad += 1
+            print(f"    # DROPPED (fails to prove): ({rows}, {cols}, "
+                  f"{q1!r}, {q2!r})")
+    print(")")
+    return 1 if bad else 0
+
+
+def _certify(spec: str) -> int:
+    from minpaxos_tpu.verify.quorum import (
+        certify_threshold, verify_certificate)
+
+    try:
+        n, q1, q2 = (int(x) for x in spec.split(","))
+        cert = certify_threshold(n, q1, q2)
+    except ValueError as e:
+        print(f"bad --certify spec {spec!r}: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(cert.to_dict(), indent=1))
+    if cert.intersects and verify_certificate(cert):
+        print(f"# certified — ledger line for GOLDEN_THRESHOLDS[{n}]: "
+              f"({q1}, {q2})")
+        return 0
+    print("# REFUTED — do NOT add to the ledger; the witness above is "
+          "a split-brain schedule seed")
+    return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "paxmc", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI gate: fixed bounds, mutant self-test, "
+                        f"{SMOKE_BUDGET_S:.0f} s budget, MC.json")
+    p.add_argument("--protocol", default="all",
+                   help="minpaxos | classic | mencius | all")
+    p.add_argument("--depth", type=int, default=None)
+    p.add_argument("--cmds", type=int, default=None)
+    p.add_argument("--drops", type=int, default=None)
+    p.add_argument("--dups", type=int, default=None)
+    p.add_argument("--reorders", type=int, default=None)
+    p.add_argument("--internal", type=int, default=None)
+    p.add_argument("--mutant", choices=["broken-quorum"], default=None,
+                   help="seeded mutant: quorum threshold forced to 1 "
+                        "(non-intersecting at N=3); exit 0 iff the "
+                        "counterexample is found")
+    p.add_argument("--replay", default=None, metavar="CE_JSON",
+                   help="replay a counterexample trace; exit 0 iff the "
+                        "violation reproduces")
+    p.add_argument("--emit-trace", default="", metavar="FILE",
+                   help="write the first counterexample (JSON) here")
+    p.add_argument("--emit-faultplan", default=None, metavar="CE_JSON",
+                   help="project a counterexample onto a chaos "
+                        "FaultPlan schedule (stdout)")
+    p.add_argument("--json", default="",
+                   help="write the full verdict to this file")
+    p.add_argument("--certify", default=None, metavar="N,Q1,Q2",
+                   help="certify one threshold quorum pair and print "
+                        "the ledger line")
+    p.add_argument("--print-quorum-golden", action="store_true",
+                   help="emit the re-verified certified quorum ledger")
+    args = p.parse_args(argv)
+
+    if args.print_quorum_golden:
+        return _print_quorum_golden()
+    if args.certify:
+        return _certify(args.certify)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from minpaxos_tpu.utils.backend import enable_compile_cache
+
+    enable_compile_cache()
+
+    from minpaxos_tpu.verify.mc import (
+        PROTOCOLS,
+        Explorer,
+        counterexample_faultplan,
+        replay_counterexample,
+    )
+
+    if args.emit_faultplan:
+        ce = json.loads(Path(args.emit_faultplan).read_text())
+        print(json.dumps(counterexample_faultplan(ce), indent=1))
+        return 0
+
+    if args.replay:
+        ce = json.loads(Path(args.replay).read_text())
+        reproduced, report = replay_counterexample(ce)
+        print(json.dumps({"reproduced": reproduced,
+                          "report": report.to_dict()}, indent=1))
+        return 0 if reproduced else 1
+
+    def override(b):
+        kw = {}
+        for name, val in (("max_depth", args.depth), ("n_cmds", args.cmds),
+                          ("drops", args.drops), ("dups", args.dups),
+                          ("reorders", args.reorders),
+                          ("internal", args.internal)):
+            if val is not None:
+                kw[name] = val
+        from dataclasses import replace
+        return replace(b, **kw) if kw else b
+
+    if args.mutant:
+        b = override(_mutant_bounds())
+        proto = "minpaxos" if args.protocol == "all" else args.protocol
+        res = Explorer(proto, b, majority_override=1).run(log=print)
+        found = res.counterexample is not None
+        line = {"mutant": args.mutant, "protocol": proto,
+                "counterexample_found": found, "states": res.states,
+                "wall_s": round(res.wall_s, 1)}
+        if found:
+            reproduced, _rep = replay_counterexample(
+                res.counterexample.to_dict())
+            line["replay_reproduced"] = reproduced
+            if args.emit_trace:
+                Path(args.emit_trace).write_text(
+                    json.dumps(res.counterexample.to_dict(), indent=1))
+                line["trace"] = args.emit_trace
+        print(f"[paxmc] {json.dumps(line)}", flush=True)
+        if args.json:
+            verdict = dict(line, result=res.to_dict())
+            Path(args.json).write_text(json.dumps(verdict, indent=1))
+        return 0 if found and line.get("replay_reproduced") else 1
+
+    # ------------------------------------------------ verification runs
+    legs = _smoke_legs()
+    if args.protocol != "all":
+        if args.protocol not in PROTOCOLS:
+            p.error(f"unknown protocol {args.protocol!r}")
+        legs = [l for l in legs if l[0] == args.protocol]
+    legs = [(proto, override(b), mut) for proto, b, mut in legs]
+
+    t_start = time.monotonic()
+    t_budget = None
+    runs = []
+    ok = True
+    for proto, b, mut in legs:
+        print(f"[paxmc] exploring {proto} (depth {b.max_depth}, "
+              f"{b.n_cmds} cmds, drops {b.drops}, dups {b.dups}) ...",
+              flush=True)
+        res = Explorer(proto, b, majority_override=mut).run(log=print)
+        if t_budget is None:
+            t_budget = time.monotonic()  # first run covered jit compile
+        runs.append(res)
+        ok = ok and res.ok and res.drained
+        print(f"[paxmc]   -> {'ok' if res.ok else 'VIOLATION'} "
+              f"states={res.states} transitions={res.transitions} "
+              f"drained={res.drained} wall={res.wall_s:.1f}s", flush=True)
+        if res.counterexample is not None and args.emit_trace:
+            Path(args.emit_trace).write_text(
+                json.dumps(res.counterexample.to_dict(), indent=1))
+            print(f"[paxmc] counterexample written to {args.emit_trace}",
+                  flush=True)
+
+    verdict = {"ok": ok, "runs": [r.to_dict() for r in runs],
+               "wall_s": round(time.monotonic() - t_start, 2)}
+
+    if args.smoke:
+        # seeded-mutant self-test: a checker that cannot find a planted
+        # non-intersecting quorum certifies nothing
+        res = Explorer("minpaxos", _mutant_bounds(),
+                       majority_override=1).run()
+        found = res.counterexample is not None
+        reproduced = found and replay_counterexample(
+            res.counterexample.to_dict())[0]
+        verdict["mutant_self_test"] = {
+            "found": found, "replay_reproduced": reproduced,
+            "states": res.states, "wall_s": round(res.wall_s, 1),
+            "trace_len": (len(res.counterexample.trace) if found else 0)}
+        ok = ok and found and reproduced
+        checked_wall = time.monotonic() - (t_budget or t_start)
+        verdict["budget_s"] = SMOKE_BUDGET_S
+        verdict["within_budget"] = checked_wall <= SMOKE_BUDGET_S
+        if not verdict["within_budget"]:
+            ok = False
+        verdict["ok"] = ok
+        verdict["wall_s"] = round(time.monotonic() - t_start, 2)
+        # the committed MC.json artifact is regenerated explicitly via
+        # `--smoke --json MC.json` (the CHAOS.json convention) — the
+        # bare CI gate must not dirty the tree with fresh wall clocks
+        # on every tier-1 run
+        print(f"[paxmc] smoke verdict ready "
+              f"(post-compile wall {checked_wall:.1f}s / budget "
+              f"{SMOKE_BUDGET_S:.0f}s)", flush=True)
+
+    line = {"ok": ok,
+            "states": sum(r.states for r in runs),
+            "transitions": sum(r.transitions for r in runs),
+            "violations": sum(0 if r.ok else 1 for r in runs),
+            "drained": all(r.drained for r in runs),
+            "wall_s": verdict["wall_s"]}
+    if args.smoke:
+        line["mutant_self_test"] = verdict["mutant_self_test"]["found"]
+    print(f"[paxmc] verdict: {json.dumps(line)}", flush=True)
+    if args.json:
+        Path(args.json).write_text(json.dumps(verdict, indent=1))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,10 +29,21 @@ python tools/lint.py || exit 1
 echo "== paxmon smoke (recorder overhead + paxtop --once --json) =="
 python tools/obs_smoke.py || exit 1
 
-# paxchaos smoke third: two fixed-seed fault schedules (partition-heal
-# + 10% loss/reorder) against a real in-process cluster, invariant-
-# checked (ROBUSTNESS.md). This one boots JAX; the budget clock starts
-# after the first run so the one-time jit compile doesn't count.
+# paxmc smoke third: bounded model checking of the real protocol
+# kernels — all 3 protocols explored exhaustively at the smoke bounds
+# (every per-link delivery order, one drop, one dup, a concurrent
+# election), every reached state held to the shared invariant suite,
+# plus a seeded broken-quorum mutant that MUST yield a replayable
+# counterexample (VERIFY.md). First JAX boot of the gate; budget
+# clock starts after the first protocol's jit compile.
+echo "== paxmc smoke (bounded model check: 3 protocols + quorum mutant) =="
+env JAX_PLATFORMS=cpu python tools/mc.py --smoke || exit 1
+
+# paxchaos smoke fourth: two fixed-seed fault schedules (partition-heal
+# + 10% loss/reorder) against a real in-process cluster, checked with
+# the SAME invariant predicates the model checker just proved at small
+# bounds (ROBUSTNESS.md). Budget clock starts after the first run so
+# the one-time jit compile doesn't count.
 echo "== paxchaos smoke (2 seeded fault schedules + invariant checker) =="
 env JAX_PLATFORMS=cpu python tools/chaos.py --smoke || exit 1
 
