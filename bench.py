@@ -11,6 +11,23 @@ device (per-call latency ~100ms+) report device throughput instead of
 dispatch latency (the BENCH_r02 failure mode: 2-9 s/step wall for ms of
 compute).
 
+Round 6, PR 8: the measured loop is DEVICE-RESIDENT by default
+(``sharded_run_resident``): workload rows come from the counter-based
+on-device generator (ops/workload.py, Threefry keyed on seed x round x
+shard), round state and latency bookkeeping live in donated buffers,
+and each measured dispatch reads back only two scalars (committed
+frontier + in-flight count) — per-slot quorum latency accumulates in
+an on-device histogram read once after the measured window, so the
+steady state performs zero per-round host->device transfers.
+``BENCH_RESIDENT=0`` restores the host-in-the-loop legacy phases
+(per-dispatch [k, G] cursor-history readback + host-side latency
+reconstruction) for A/B; both paths draw the same proposal stream, so
+their committed results are identical at a pinned shape
+(tests/test_workload.py). ``--ladder`` sweeps
+tools/shape_ladder.py's (shards x window x proposals x k) grid first
+and measures at the throughput-optimal point instead of the
+hand-picked shape; the sweep and winner land in the artifact.
+
 Reported timing is split honestly:
 * ``device_ms_per_round`` — median dispatch wall / k (the chip's rate);
 * ``dispatch_overhead_ms`` — wall of a k=1 dispatch minus one round at
@@ -52,6 +69,18 @@ import time
 # throughput 31k -> 14k inst/s). Default 1; the record carries the
 # value used, so any substeps>1 number is labeled as such.
 SS_N = int(os.environ.get("MP_BENCH_SUBSTEPS", "1"))
+
+# BENCH_RESIDENT=0 restores the host-in-the-loop measured phases
+# (per-dispatch [k, G] cursor-history readback + host latency
+# reconstruction — the PR-7 loop, verbatim) for A/B against the
+# device-resident default. Both loops draw the identical proposal
+# stream (ops/workload.py), so committed results match byte-for-byte
+# at a pinned shape; only the loop structure differs.
+RESIDENT = os.environ.get("BENCH_RESIDENT", "1") != "0"
+
+# workload PRNG base key — the whole proposal stream is a pure
+# function of (seed, round), bit-reproducible across runs/hosts
+WORKLOAD_SEED = int(os.environ.get("MP_BENCH_SEED", "0"))
 
 
 def _progress(msg: str) -> None:
@@ -187,6 +216,76 @@ def _latency_rounds(uptos, crts, round_ms):
             int(lat.size), uncommitted)
 
 
+def cpu_catchup_rows(p: int, fault: bool) -> int:
+    """CPU catch-up sizing, the ONE definition bench.py and
+    tools/shape_ladder.py share (a silent divergence would re-measure
+    a ladder winner at a different inbox shape than the one that won
+    the sweep). Fault-viable sizing must OUTPACE the live commit
+    stream while a revived victim's frontier is pinned at its hole
+    (measured: cu >= 2p reheals, cu <= p/2 never does — PERF.md);
+    throughput shapes skip the fault leg and use economy sizing
+    (inbox rows cost ~50 us/row/round on the measured host)."""
+    return max(64, min(512, 2 * p)) if fault else max(32, min(256, p // 4))
+
+
+def cpu_key_space(p: int) -> int:
+    """Workload key-space sizing for CPU shapes, shared with the shape
+    ladder: the smallest power of two >= max(256, p). The stride-walk
+    key schedule (ops/workload.py) is duplicate-free within a round
+    only while rows <= key_space — an undersized space at big p would
+    re-introduce the KV claim-loop serialization the generator exists
+    to avoid, and would do it unevenly across ladder points, crowning
+    the wrong winner."""
+    return 1 << max(8, (p - 1).bit_length())
+
+
+def cpu_kv_pow2(p: int) -> int:
+    """KV capacity to go with ``cpu_key_space``: 4x the key space, the
+    same saturation headroom the fixed (2^8 keys, 2^10 table) CPU
+    default always had."""
+    return max(10, (cpu_key_space(p) - 1).bit_length() + 2)
+
+
+def _latency_from_hist(hist, round_ms):
+    """Exact percentiles from the device-accumulated round-latency
+    histogram (resident loop). Latencies are integers in ROUNDS (bin b
+    = b+1 rounds), so the full per-slot sample is reconstructible with
+    ``np.repeat`` and the percentiles match ``_latency_rounds`` on the
+    same run bit-for-bit (pinned by tests/test_workload.py). Returns
+    (p50_ms, p99_ms, n_samples, overflow_count) — overflow is the last
+    bin's population (latency >= LATENCY_BINS rounds), reported so a
+    clipped tail can never silently pass as a complete sample."""
+    import numpy as np
+
+    n = int(hist.sum())
+    overflow = int(hist[-1])
+    if n == 0:
+        return float("nan"), float("nan"), 0, overflow
+    if n <= (1 << 22):
+        # reconstruct the sample outright: matches np.percentile of
+        # the host path to the bit (the equivalence tests' contract)
+        lat = np.repeat(np.arange(1, hist.size + 1, dtype=np.int64),
+                        hist) * round_ms
+        return (float(np.percentile(lat, 50)),
+                float(np.percentile(lat, 99)), n, overflow)
+    # at accelerator scale (north-star runs commit tens of millions)
+    # materializing the sample is hundreds of MB — take the exact
+    # order statistics from the cumulative counts instead. Latencies
+    # are integers, so sample[i] is just the first bin whose cumsum
+    # exceeds i; linear interpolation between the two bracketing
+    # order statistics mirrors np.percentile's default.
+    cum = np.cumsum(hist.astype(np.int64))
+
+    def pct(q):
+        pos = (n - 1) * q / 100.0
+        lo, hi = int(np.floor(pos)), int(np.ceil(pos))
+        v_lo = (int(np.searchsorted(cum, lo, side="right")) + 1) * round_ms
+        v_hi = (int(np.searchsorted(cum, hi, side="right")) + 1) * round_ms
+        return float(v_lo + (v_hi - v_lo) * (pos - lo))
+
+    return pct(50), pct(99), n, overflow
+
+
 def _side_config(cfg, g, p, k, protocol, dispatches=2):
     """One BASELINE side config: small fused run, returns a record.
 
@@ -245,24 +344,32 @@ def _side_config(cfg, g, p, k, protocol, dispatches=2):
     }
 
 
-def measure(shape: tuple[int, int, int, int] | None = None) -> None:
+def measure(shape: tuple[int, int, int, int] | None = None,
+            cpu_ok: bool = False, ladder: dict | None = None) -> None:
     """One full measurement pass (headline + fault leg + side configs)
     at the given (g, w, p, k) shape, emitting the JSON record. Runs in
     a CHILD process under main()'s shape ladder: a too-big shape can
     crash the remote TPU worker outright (observed: 'TPU worker
     process crashed or restarted' during the 1M-instance warmup), and
     a crashed worker poisons the in-process backend — only a fresh
-    process can retry."""
+    process can retry. ``cpu_ok`` marks a deliberately-CPU explicit
+    shape (the ``--ladder`` mode measuring at the autotuned point);
+    ``ladder`` is that mode's sweep record, stamped into the artifact.
+    """
     devices = _init_backend(progress=_progress, on_fail=_failure)
     import jax
     import numpy as np
 
     from minpaxos_tpu.models.minpaxos import MinPaxosConfig
-    from minpaxos_tpu.parallel.sharded import ShardedCluster, shard_cursors
+    from minpaxos_tpu.parallel.sharded import (
+        DONATION,
+        ShardedCluster,
+        shard_cursors,
+    )
 
     platform = devices[0].platform
     on_tpu = platform not in ("cpu",)
-    if shape is not None and not on_tpu:
+    if shape is not None and not on_tpu and not cpu_ok:
         # the ladder asked for a TPU shape but the backend fell back to
         # CPU (worker still respawning): fail fast, the driver retries
         _failure("child", f"backend fell back to {platform}")
@@ -283,10 +390,6 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
     else:
         g, w, p, k = 8, 512, 64, 8
         healthy_d, k_dead, rec_d = 2, 2, 2
-    # catchup_rows sized so the fault leg can REHEAL under full load:
-    # the dead-phase gap is dead_d*k*p slots per shard and catch-up
-    # ships catchup_rows/2 per round (most-lagging-peer ticks), so
-    # recovery needs ~2*gap/catchup_rows rounds < rec_d*k.
     # kv_pow2 15 = 32k entries vs the 16k-key workload key_space: 2x
     # headroom at half the HBM of the former 2^16 tables (the KV is the
     # dominant allocation — ~0.9 GB saved at g=256)
@@ -297,32 +400,67 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
     # sizing paid for (R-1)*p per-slot ack rows that no longer exist.
     # Every [M]-shaped step computation and routed array shrinks with
     # it (measured 30% faster fused rounds on the CPU mesh).
-    cu_rows = 512 if on_tpu else 128
+    # CPU catch-up sizing (PR 8, measured): while a revived victim
+    # still has a hole, its commit FRONTIER is pinned at the hole, so
+    # catch-up must outpace the live commit stream, not just clear the
+    # gap — empirically cu >= 2p reheals in ~one dispatch and cu <= p/2
+    # never reheals (tools/ notes in PERF.md). Inbox capacity costs
+    # ~50 us/row/round on the measured host, so when the fault leg is
+    # OFF (ladder-chosen throughput shapes — same policy as the TPU
+    # ladder's bigger rungs) cu drops to economy sizing instead.
+    do_fault = os.environ.get("MP_BENCH_FAULT", "1") != "0"
+    cu_rows = 512 if on_tpu else cpu_catchup_rows(p, do_fault)
     cfg = MinPaxosConfig(
         n_replicas=5, window=w, inbox=p + 2 * cu_rows + 64 + 64,
-        exec_batch=p, kv_pow2=15 if on_tpu else 10,
+        exec_batch=p, kv_pow2=15 if on_tpu else cpu_kv_pow2(p),
         catchup_rows=cu_rows, recovery_rows=64)
     t_boot = time.perf_counter()
     try:
         # key_space < KV capacity: the run inserts ~dispatches*k*p
         # distinct keys per shard otherwise, saturating the table
         # mid-measurement (kv.dropped) and degenerating probe chains
-        sc = ShardedCluster(cfg, g, ext_rows=p,
-                            key_space=1 << (14 if on_tpu else 8))
+        # --ladder winners may mesh the shard axis over virtual CPU
+        # devices (the sweep measured them that way); default 1 = the
+        # classic single-device layout
+        shard_devices = int(os.environ.get("MP_BENCH_SHARD_DEVICES", "1"))
+        mesh = None
+        if shard_devices > 1 and len(devices) >= shard_devices:
+            from minpaxos_tpu.parallel import make_mesh
+
+            mesh = make_mesh(n_shard_devices=shard_devices,
+                             n_replica_devices=1)
+        # the artifact must stamp the layout the run ACTUALLY used —
+        # a requested-but-unbuildable mesh (backend fell back, fewer
+        # devices than asked) degrades to single-device and says so
+        shard_devices = shard_devices if mesh is not None else 1
+        sc = ShardedCluster(cfg, g, ext_rows=p, mesh=mesh,
+                            key_space=(1 << 14) if on_tpu
+                            else cpu_key_space(p),
+                            seed=WORKLOAD_SEED)
         _progress(f"init {time.perf_counter() - t_boot:.1f}s")
         sc.elect(0)
         _progress(f"elect {time.perf_counter() - t_boot:.1f}s")
 
-        # -- warmup / compile (k, k_dead and k=1 variants) --
-        sc.run_fused(k, p, substeps=SS_N)
-        sc.run_fused(k_dead, p, substeps=SS_N)
-        sc.run_fused(1, p, substeps=SS_N)
+        # -- warmup / compile (k, k_dead and k=1 variants of whichever
+        # loop this run measures) --
+        if RESIDENT:
+            sc.begin_resident()
+            sc.run_resident(k, p, substeps=SS_N)
+            sc.run_resident(k_dead, p, substeps=SS_N)
+            sc.run_resident(1, p, substeps=SS_N)
+        else:
+            sc.run_fused(k, p, substeps=SS_N)
+            sc.run_fused(k_dead, p, substeps=SS_N)
+            sc.run_fused(1, p, substeps=SS_N)
         _progress(f"warmup/compile {time.perf_counter() - t_boot:.1f}s")
 
         # -- dispatch overhead probe: k=1 dispatches, blocked --
         t0 = time.perf_counter()
         for _ in range(3):
-            sc.run_fused(1, p, substeps=SS_N)  # np.asarray inside blocks until ready
+            if RESIDENT:
+                sc.run_resident(1, p, substeps=SS_N)  # scalar read blocks
+            else:
+                sc.run_fused(1, p, substeps=SS_N)  # np.asarray blocks
         k1_ms = (time.perf_counter() - t0) / 3 * 1e3
 
         # -- optional device profile: MP_BENCH_PROFILE=<dir> wraps the
@@ -352,15 +490,29 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
 
         # -- measured phase 1: healthy, healthy_d fused dispatches --
         start_committed, _, _ = sc.committed()
-        u0, c0 = shard_cursors(cfg, sc.leader, sc.ss)
-        # pre-phase cursor row so round-1 injections aren't censored
-        U, C = [np.asarray(u0)[None].copy()], [np.asarray(c0)[None].copy()]
+        U, C = [], []
+        if RESIDENT:
+            # fresh bookkeeping: warmup-injected slots are excluded
+            # from the latency sample exactly as the legacy path's
+            # pre-phase cursor row excludes them
+            sc.begin_resident()
+            committed_cursor = start_committed
+        else:
+            u0, c0 = shard_cursors(cfg, sc.leader, sc.ss)
+            # pre-phase cursor row so round-1 injections aren't censored
+            U, C = [np.asarray(u0)[None].copy()], [np.asarray(c0)[None].copy()]
         walls = [time.perf_counter()]
         with prof_cm:
             for i in range(healthy_d):
-                u, c = sc.run_fused(k, p, substeps=SS_N)
-                U.append(u)
-                C.append(c)
+                if RESIDENT:
+                    # back-to-back dispatches; the only per-dispatch
+                    # host sync is the two-scalar cursor readback
+                    committed_cursor, _ = sc.run_resident(
+                        k, p, substeps=SS_N)
+                else:
+                    u, c = sc.run_fused(k, p, substeps=SS_N)
+                    U.append(u)
+                    C.append(c)
                 walls.append(time.perf_counter())
                 mx_disp.inc()
                 mx_rounds.inc(k)
@@ -369,20 +521,29 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
                           f"{(walls[-1] - walls[-2]) * 1e3:.0f}ms / {k} rounds")
         healthy_wall = walls[-1] - walls[0]
         healthy_rounds = healthy_d * k
-        committed_healthy = int((U[-1][-1] + 1).sum()) - start_committed
+        if RESIDENT:
+            committed_healthy = committed_cursor - start_committed
+        else:
+            committed_healthy = int((U[-1][-1] + 1).sum()) - start_committed
         mx_committed.set(committed_healthy)
         throughput = committed_healthy / healthy_wall
         round_ms = healthy_wall / healthy_rounds * 1e3
 
-        if shape is not None:
+        if shape is not None and on_tpu:
             # Ladder child: the fault leg can wedge the remote worker
             # (observed: rung (128,4096,512,16) hung >20 min after four
             # clean healthy dispatches and the parent discarded the
             # whole rung). Emit the healthy-phase record NOW — the
             # parent salvages it from a timed-out child's partial
             # stdout; a complete record printed later supersedes it.
-            hp50, hp99, hn, hunc = _latency_rounds(
-                np.concatenate(U), np.concatenate(C), round_ms)
+            # (The measured window is over, so a resident-mode
+            # histogram read here is the sanctioned post-window one.)
+            if RESIDENT:
+                hp50, hp99, hn, _hov = _latency_from_hist(
+                    sc.resident_hist(), round_ms)
+            else:
+                hp50, hp99, hn, hunc = _latency_rounds(
+                    np.concatenate(U), np.concatenate(C), round_ms)
             _emit({
                 "metric": "committed_instances_per_sec",
                 "value": round(throughput, 1),
@@ -397,6 +558,7 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
                 "latency_samples": hn,
                 "concurrent_instances": g * w,
                 "substeps": SS_N,
+                "resident": RESIDENT,
                 "proposals_per_round": g * p,
                 "n_replicas": cfg.n_replicas,
                 "n_shards": g,
@@ -416,16 +578,21 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         # the ladder exercises kill/recover at its FIRST rung only and
         # keeps the bigger rungs' throughput measurements out of the
         # blast radius; the record labels what ran. --
-        do_fault = os.environ.get("MP_BENCH_FAULT", "1") != "0"
         if do_fault:
             victim = 2
             sc.kill(victim)
             t0 = time.perf_counter()
-            du, dc = sc.run_fused(k_dead, p, substeps=SS_N)
-            DU, DC = [du], [dc]
+            DU, DC = [], []
+            if RESIDENT:
+                cd, _ = sc.run_resident(k_dead, p, substeps=SS_N)
+                committed_dead = cd - committed_cursor
+                committed_cursor = cd
+            else:
+                du, dc = sc.run_fused(k_dead, p, substeps=SS_N)
+                DU, DC = [du], [dc]
+                committed_dead = int((DU[-1][-1] + 1).sum()) - int(
+                    (U[-1][-1] + 1).sum())
             dead_wall = time.perf_counter() - t0
-            committed_dead = int((DU[-1][-1] + 1).sum()) - int(
-                (U[-1][-1] + 1).sum())
             # the dead phase is one SHORT dispatch, so per-dispatch
             # tunnel overhead (measured via the k=1 probe) would
             # dominate its wall and masquerade as fault impact —
@@ -434,15 +601,25 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
             overhead_s = max(k1_ms - round_ms, 0.0) / 1e3
             dead_throughput = committed_dead / max(
                 dead_wall - overhead_s, 1e-6)
-            leader_frontier_at_revive = DU[-1][-1].copy()
+            if RESIDENT:
+                # one [G] read between phases — fault-leg diagnostics,
+                # not the measured steady state
+                lu, _ = shard_cursors(cfg, sc.leader, sc.ss)
+                leader_frontier_at_revive = np.asarray(lu).copy()
+            else:
+                leader_frontier_at_revive = DU[-1][-1].copy()
             sc.revive(victim)
             recover_rounds = None
             RU, RC = [], []
             t0 = time.perf_counter()
             for d in range(rec_d):
-                u, c = sc.run_fused(k, p, substeps=SS_N)
-                RU.append(u)
-                RC.append(c)
+                if RESIDENT:
+                    committed_cursor, _ = sc.run_resident(
+                        k, p, substeps=SS_N)
+                else:
+                    u, c = sc.run_fused(k, p, substeps=SS_N)
+                    RU.append(u)
+                    RC.append(c)
                 vup = np.asarray(sc.ss.states.committed_upto[:, victim])
                 if recover_rounds is None and (
                         vup >= leader_frontier_at_revive).all():
@@ -470,22 +647,40 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         # -- drain: no new proposals until fully committed (no censored
         # tail in the latency sample) --
         drain_rounds = 0
-        for _ in range(8):
-            u, c = sc.run_fused(k, 0, substeps=SS_N)
-            RU.append(u)
-            RC.append(c)
-            drain_rounds += k
-            if (np.asarray(sc.ss.states.committed_upto[:, sc.leader])
-                    >= np.asarray(sc.ss.states.crt_inst[:, sc.leader]) - 1).all():
-                break
+        if RESIDENT:
+            in_flight = None
+            for _ in range(8):
+                committed_cursor, in_flight = sc.run_resident(
+                    k, 0, substeps=SS_N)
+                drain_rounds += k
+                if in_flight == 0:
+                    break
+        else:
+            for _ in range(8):
+                u, c = sc.run_fused(k, 0, substeps=SS_N)
+                RU.append(u)
+                RC.append(c)
+                drain_rounds += k
+                if (np.asarray(sc.ss.states.committed_upto[:, sc.leader])
+                        >= np.asarray(sc.ss.states.crt_inst[:, sc.leader]) - 1).all():
+                    break
 
         # -- latency over the WHOLE run (healthy + dead + recovery +
         # drain), in rounds at the healthy fused rate --
-        uptos = np.concatenate(U + DU + RU, axis=0)
-        crts = np.concatenate(C + DC + RC, axis=0)
-        p50, p99, n_lat, uncommitted = _latency_rounds(uptos, crts, round_ms)
-
-        committed_total = int((uptos[-1] + 1).sum())
+        hist_overflow = 0
+        if RESIDENT:
+            # the ONE full readback, after the measured window: exact
+            # per-slot latencies from the device-accumulated histogram
+            p50, p99, n_lat, hist_overflow = _latency_from_hist(
+                sc.end_resident(), round_ms)
+            uncommitted = int(in_flight)
+            committed_total = int(committed_cursor)
+        else:
+            uptos = np.concatenate(U + DU + RU, axis=0)
+            crts = np.concatenate(C + DC + RC, axis=0)
+            p50, p99, n_lat, uncommitted = _latency_rounds(
+                uptos, crts, round_ms)
+            committed_total = int((uptos[-1] + 1).sum())
         result = {
             "metric": "committed_instances_per_sec",
             "value": round(throughput, 1),
@@ -505,9 +700,24 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
             "p99_quorum_decision_ms": round(p99, 3),
             "latency_samples": n_lat,
             "latency_uncommitted_after_drain": uncommitted,
+            "latency_hist_overflow": hist_overflow,
             "drain_rounds": drain_rounds,
             "concurrent_instances": g * w,
             "substeps": SS_N,
+            # PR 8 provenance: which measured loop produced this
+            # record, under what donation discipline, from which
+            # workload stream — and, in --ladder mode, the sweep that
+            # picked the shape. Old consumers ignore unknown keys;
+            # records from pre-resident trees parse as resident=False
+            # via .get("resident", False).
+            "resident": RESIDENT,
+            "donation": DONATION,
+            "workload": {"generator": "threefry2x32",
+                         "seed": WORKLOAD_SEED},
+            "shape": {"n_shards": g, "window": w, "proposals": p,
+                      "rounds_per_dispatch": k, "catchup_rows": cu_rows,
+                      "shard_devices": shard_devices,
+                      "ladder_chosen": ladder is not None},
             "proposals_per_round": g * p,
             "committed_total": committed_total,
             "metrics": mx.snapshot(),
@@ -519,6 +729,8 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
                          "<10ms p50, v5e-8/8); reference publishes none "
                          "(BASELINE.md)"),
         }
+        if ladder is not None:
+            result["ladder"] = ladder
 
         # -- BASELINE side configs 2-4 (config 1, the TCP runtime, is
         # measured separately: bench_tcp.py writes BENCH_TCP.json) --
@@ -594,6 +806,74 @@ def measure(shape: tuple[int, int, int, int] | None = None) -> None:
         sys.exit(0)
 
 
+def _run_ladder_mode() -> None:
+    """``bench.py --ladder``: run the shape-ladder autotuner
+    (tools/shape_ladder.py) as a subprocess, then measure the full
+    record at the throughput-optimal point in a child with the same
+    virtual-device environment. The sweep record rides the artifact
+    (``ladder``), so the headline documents the alternatives its shape
+    beat. Budget via MP_BENCH_LADDER_BUDGET_S (default 900 s)."""
+    import tempfile
+
+    ncpu = os.cpu_count() or 1
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        # the sweep's meshed points and the measured winner must see
+        # the same device count, or the winner is irreproducible
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count={ncpu}"
+                            ).strip()
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "shape_ladder.py")
+    fd, sweep_path = tempfile.mkstemp(suffix="_ladder.json")
+    os.close(fd)
+    budget = os.environ.get("MP_BENCH_LADDER_BUDGET_S", "900")
+    _progress(f"ladder sweep (budget {budget}s, {ncpu} virtual devices)")
+    try:
+        proc = subprocess.run(
+            [sys.executable, tool, "--json", sweep_path,
+             "--budget-s", budget],
+            env=env, stdout=subprocess.DEVNULL, timeout=3600.0)
+        if proc.returncode != 0:
+            _failure("ladder-sweep", f"shape_ladder rc={proc.returncode}")
+            return
+        with open(sweep_path) as f:
+            sweep = json.load(f)
+        win = sweep.get("winner")
+        if not win:
+            _failure("ladder-sweep", "no legal (exactly-drained) point")
+            return
+        _progress(f"ladder winner: g={win['g']} w={win['w']} p={win['p']} "
+                  f"k={win['k']} sd={win['shard_devices']} "
+                  f"({win['inst_per_sec']:.0f} inst/s in the sweep)")
+        env2 = dict(env,
+                    MP_BENCH_CHILD=",".join(str(win[x])
+                                            for x in ("g", "w", "p", "k")),
+                    MP_BENCH_CPU_OK="1",
+                    MP_BENCH_LADDER_FILE=sweep_path,
+                    MP_BENCH_SHARD_DEVICES=str(win["shard_devices"]),
+                    # throughput shapes use economy catch-up sizing;
+                    # kill/recover stays with the default-shape run
+                    # (same policy as the TPU ladder's bigger rungs)
+                    MP_BENCH_FAULT="0")
+        proc = subprocess.run([sys.executable, __file__], env=env2,
+                              stdout=subprocess.PIPE, timeout=3600.0)
+        lines = [ln for ln in proc.stdout.decode().splitlines()
+                 if ln.strip().startswith("{")]
+        if proc.returncode != 0 or not lines:
+            _failure("ladder-measure", f"child rc={proc.returncode}")
+            return
+        print(lines[-1])
+    except subprocess.TimeoutExpired:
+        _failure("ladder", "sweep or measure child hung > 3600s")
+    finally:
+        try:
+            os.remove(sweep_path)
+        except OSError:
+            pass
+
+
 def main() -> None:
     """Shape-ladder driver: run measure() in a child process per
     attempt, CLIMBING from the smallest shape to the north-star shape
@@ -612,9 +892,22 @@ def main() -> None:
     import os
 
     if os.environ.get("MP_BENCH_CHILD"):
+        ladder_rec = None
+        if os.environ.get("MP_BENCH_LADDER_FILE"):
+            with open(os.environ["MP_BENCH_LADDER_FILE"]) as f:
+                ladder_rec = json.load(f)
         measure(tuple(int(x) for x in
                       os.environ["MP_BENCH_CHILD"].split(","))
-                if "," in os.environ["MP_BENCH_CHILD"] else None)
+                if "," in os.environ["MP_BENCH_CHILD"] else None,
+                cpu_ok=os.environ.get("MP_BENCH_CPU_OK") == "1",
+                ladder=ladder_rec)
+        return
+    if "--ladder" in sys.argv[1:]:
+        # autotuned mode: sweep tools/shape_ladder.py's grid first,
+        # then measure the full record at the throughput-optimal point
+        # (a child process, so the winner runs with the shard axis
+        # meshed over every virtual CPU device the sweep used).
+        _run_ladder_mode()
         return
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         measure()  # explicit CPU run: tiny shape, no ladder needed

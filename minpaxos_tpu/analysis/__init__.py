@@ -51,6 +51,7 @@ from minpaxos_tpu.analysis import (  # noqa: E402,F401  (registration)
     lock_order,
     quorum_certificate,
     recompile_hazard,
+    resident_loop,
     trace_hazard,
     wall_honesty,
     wire_contract,

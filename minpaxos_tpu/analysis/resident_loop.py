@@ -1,0 +1,247 @@
+"""resident-loop: no host sync may creep into the measured loop.
+
+PR 8 made the benchmark's steady state fully device-resident: the
+dispatch path (``sharded_run_resident`` and everything it traces)
+performs zero per-round host<->device transfers, and the host wrapper
+reads back exactly two scalars per dispatch. That property is the
+whole point of the optimization — and it is one innocent
+``np.asarray`` away from silently regressing into a per-dispatch
+stall that only shows up as a mysteriously slow bench (the round-2
+pathology, re-armed).
+
+This pass makes the property structural. Functions carrying a
+
+    # paxlint: resident-loop
+
+marker (on the line above the ``def``/decorators, on them, or on the
+first body line) are *measured-loop dispatch functions*. From each
+marked root, calls are followed transitively through the scoped
+packages (same-module calls, ``from minpaxos_tpu.x import f`` /
+``mod.f`` imports, same-class ``self.method()``, and bare function
+references — the ``functools.partial``/``vmap`` idiom). Every reached
+function is held to:
+
+* no ``np.asarray`` / ``np.array`` family calls (device -> host pull);
+* no ``.item()``, ``jax.block_until_ready``, ``jax.device_get``;
+* no host callbacks (``jax.pure_callback``,
+  ``jax.experimental.io_callback``, ``jax.debug.callback``, anything
+  ``host_callback``);
+* in the marked functions THEMSELVES (the host-edge dispatch
+  wrappers): no ``int()``/``float()``/``bool()`` coercions of
+  non-literals — there, a coercion IS a scalar readback. The ONE
+  sanctioned per-dispatch readback (``ShardedCluster.run_resident``)
+  carries an explicit ``# paxlint: disable=resident-loop`` with its
+  reason, so the measured loop's host-sync surface is enumerable by
+  grepping suppressions. Reached-but-unmarked kernel code is exempt
+  from the coercion check: ``int(MsgKind.PROPOSE)``-style trace-time
+  metaprogramming is not a sync (trace-hazard already taint-checks
+  coercions of traced values there).
+
+Unmarked functions are untouched — host orchestration code is free to
+sync; the rule guards only the paths that claim residency.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from minpaxos_tpu.analysis import jitgraph
+from minpaxos_tpu.analysis.core import Project, Violation, register
+from minpaxos_tpu.analysis.jitgraph import _dotted
+
+RULE = "resident-loop"
+
+SCOPE_PREFIXES = jitgraph.DEVICE_PREFIXES
+
+_MARKER_RE = re.compile(r"#\s*paxlint:\s*resident-loop\b")
+
+_NP_CTORS = frozenset({"asarray", "array", "frombuffer",
+                       "ascontiguousarray", "copyto"})
+_CALLBACKS = frozenset({"jax.pure_callback", "jax.experimental.io_callback",
+                        "jax.debug.callback"})
+_SYNCS = frozenset({"jax.block_until_ready", "jax.device_get"})
+
+FuncRef = tuple[str, str]  # (path, qualname — "f" or "Class.m")
+
+
+class _Fn:
+    __slots__ = ("node", "imports", "cls", "path", "qual")
+
+    def __init__(self, path, qual, node, imports, cls):
+        self.path, self.qual = path, qual
+        self.node, self.imports, self.cls = node, imports, cls
+
+
+def _parse_imports(tree: ast.Module) -> dict[str, tuple[str, str | None]]:
+    imports: dict[str, tuple[str, str | None]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = (a.name, None)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                imports[a.asname or a.name] = (node.module, a.name)
+    return imports
+
+
+def _collect(project: Project):
+    """(funcs, marked_roots) over the scoped packages; methods are
+    collected with Class.name quals (jitgraph only tracks module-level
+    functions, but the measured loop's host edge is a method)."""
+    funcs: dict[FuncRef, _Fn] = {}
+    marked: list[FuncRef] = []
+    seen: set[str] = set()
+    for prefix in SCOPE_PREFIXES:
+        for f in project.glob(prefix):
+            if f.tree is None or f.path in seen:
+                continue
+            seen.add(f.path)
+            imports = _parse_imports(f.tree)
+            marker_lines = {
+                i for i, ln in enumerate(f.src.splitlines(), start=1)
+                if _MARKER_RE.search(ln)}
+
+            def add(node: ast.FunctionDef, cls: str | None,
+                    f=f, imports=imports, marker_lines=marker_lines):
+                qual = f"{cls}.{node.name}" if cls else node.name
+                ref = (f.path, qual)
+                funcs[ref] = _Fn(f.path, qual, node, imports, cls)
+                start = min([d.lineno for d in node.decorator_list]
+                            + [node.lineno])
+                first_body = (node.body[0].lineno if node.body
+                              else node.lineno)
+                if any(start - 1 <= ln <= first_body
+                       for ln in marker_lines):
+                    marked.append(ref)
+
+            for node in f.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    add(node, None)
+                elif isinstance(node, ast.ClassDef):
+                    for m in node.body:
+                        if isinstance(m, ast.FunctionDef):
+                            add(m, node.name)
+    return funcs, marked
+
+
+def _module_path(dotted_mod: str) -> str:
+    return dotted_mod.replace(".", "/") + ".py"
+
+
+def _edges(fn: _Fn, funcs: dict[FuncRef, _Fn]) -> set[FuncRef]:
+    """Project functions referenced from ``fn`` — call sites AND bare
+    references (functools.partial(f, ...), vmap(f): the fused scan
+    passes kernels around as values, and an un-followed value edge
+    would let a host sync hide one hop away)."""
+    out: set[FuncRef] = set()
+    for n in ast.walk(fn.node):
+        if isinstance(n, ast.Name):
+            if (fn.path, n.id) in funcs:
+                out.add((fn.path, n.id))
+            elif n.id in fn.imports:
+                mod, name = fn.imports[n.id]
+                if name is not None and mod.startswith("minpaxos_tpu"):
+                    ref = (_module_path(mod), name)
+                    if ref in funcs:
+                        out.add(ref)
+        elif isinstance(n, ast.Attribute):
+            d = _dotted(n)
+            if d is None:
+                continue
+            head, _, rest = d.partition(".")
+            first = rest.split(".", 1)[0] if rest else ""
+            if head == "self" and fn.cls and first:
+                ref = (fn.path, f"{fn.cls}.{first}")
+                if ref in funcs:
+                    out.add(ref)
+            elif first and head in fn.imports:
+                mod, name = fn.imports[head]
+                if name is None and mod.startswith("minpaxos_tpu"):
+                    ref = (_module_path(mod), first)
+                    if ref in funcs:
+                        out.add(ref)
+    return out
+
+
+def _full_name(node: ast.expr, imports) -> str | None:
+    """Resolve a (possibly aliased) dotted callee to its canonical
+    module path: ``block_until_ready`` imported from jax ->
+    "jax.block_until_ready"."""
+    d = _dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    if head in imports:
+        mod, name = imports[head]
+        if name is not None:  # from X import name [as head]
+            base = f"{mod}.{name}"
+        else:  # import X [as head]
+            base = mod
+        return base + ("." + rest if rest else "")
+    return d
+
+
+def _check_fn(fn: _Fn, root: FuncRef, out: list[Violation]) -> None:
+    is_root = (fn.path, fn.qual) == root
+    via = ("" if is_root
+           else f" (reachable from resident measured-loop function "
+                f"`{root[1]}`)")
+    for n in ast.walk(fn.node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Attribute) and f.attr == "item" and not n.args:
+            out.append(Violation(
+                fn.path, n.lineno, RULE,
+                f"`.item()` in the device-resident measured loop — a "
+                f"per-dispatch host sync{via}"))
+            continue
+        full = _full_name(f, fn.imports)
+        if full is not None:
+            head, _, attr = full.partition(".")
+            if head == "numpy" and attr in _NP_CTORS:
+                out.append(Violation(
+                    fn.path, n.lineno, RULE,
+                    f"`np.{attr}` pulls device data to the host inside "
+                    f"the resident measured loop{via}"))
+                continue
+            if full in _SYNCS:
+                out.append(Violation(
+                    fn.path, n.lineno, RULE,
+                    f"`{full}` blocks the resident measured loop on the "
+                    f"device{via}"))
+                continue
+            if full in _CALLBACKS or "host_callback" in full:
+                out.append(Violation(
+                    fn.path, n.lineno, RULE,
+                    f"host callback `{full}` re-enters the host from "
+                    f"the resident measured loop{via}"))
+                continue
+        if (is_root and isinstance(f, ast.Name)
+                and f.id in ("int", "float", "bool")
+                and any(not isinstance(a, ast.Constant) for a in n.args)):
+            out.append(Violation(
+                fn.path, n.lineno, RULE,
+                f"`{f.id}()` coercion is a scalar readback in the "
+                f"resident measured loop — if this is the sanctioned "
+                f"per-dispatch cursor read, mark it with a suppression "
+                f"and a reason{via}"))
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    funcs, marked = _collect(project)
+    out: list[Violation] = []
+    for root in marked:
+        visited: set[FuncRef] = set()
+        frontier = [root]
+        while frontier:
+            ref = frontier.pop()
+            if ref in visited:
+                continue
+            visited.add(ref)
+            fn = funcs[ref]
+            _check_fn(fn, root, out)
+            frontier.extend(_edges(fn, funcs) - visited)
+    return out

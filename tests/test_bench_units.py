@@ -53,6 +53,44 @@ def test_latency_uncommitted_tail_reported_not_sampled():
     assert n == 7  # slots 0-6 committed and sampled
 
 
+def test_latency_from_hist_hand_computed():
+    """Resident-loop histogram percentiles: bin b = latency b+1 rounds;
+    the sample reconstructs exactly, so percentiles match
+    np.percentile of the explicit per-slot latencies."""
+    hist = np.zeros(16, np.int32)
+    hist[1] = 3  # three slots at 2 rounds
+    hist[2] = 1  # one slot at 3 rounds
+    p50, p99, n, overflow = bench._latency_from_hist(hist, round_ms=2.0)
+    assert n == 4 and overflow == 0
+    assert p50 == np.percentile(np.array([2, 2, 2, 3]) * 2.0, 50)
+    assert p99 == np.percentile(np.array([2, 2, 2, 3]) * 2.0, 99)
+
+
+def test_latency_from_hist_empty_and_overflow():
+    p50, p99, n, overflow = bench._latency_from_hist(
+        np.zeros(8, np.int32), 1.0)
+    assert n == 0 and overflow == 0 and np.isnan(p50) and np.isnan(p99)
+    hist = np.zeros(4, np.int32)
+    hist[-1] = 5  # tail beyond the bin range: counted, reported
+    p50, p99, n, overflow = bench._latency_from_hist(hist, 1.0)
+    assert n == 5 and overflow == 5
+    assert p50 == 4.0  # clipped AT the last bin, never dropped
+
+
+def test_latency_hist_agrees_with_latency_rounds():
+    """The two latency paths are the same estimator: build a cursor
+    history, compute host-side percentiles, then bin the same per-slot
+    latencies into a histogram and compare bit-for-bit."""
+    crts = np.array([[0], [2], [4], [4], [4]])
+    uptos = np.array([[-1], [-1], [1], [2], [3]])
+    p50_a, p99_a, n_a, _ = bench._latency_rounds(uptos, crts, 1.5)
+    hist = np.zeros(512, np.int32)
+    for lat in (2, 2, 2, 3):  # hand-derived from the history above
+        hist[lat - 1] += 1
+    p50_b, p99_b, n_b, _ = bench._latency_from_hist(hist, 1.5)
+    assert (p50_a, p99_a, n_a) == (p50_b, p99_b, n_b)
+
+
 def test_latency_round_ms_scales_linearly():
     rng = np.random.default_rng(3)
     # monotone random cursor walk, 3 shards

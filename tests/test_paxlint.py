@@ -843,6 +843,125 @@ def test_cli_exit_codes_and_json(tmp_path):
     assert payload["counts"].get("wall-honesty") == 1, payload
 
 
+# ------------------------------------------------------- resident-loop
+
+
+RESIDENT_BAD = '''
+import numpy as np
+import jax
+
+def helper(state):
+    return np.asarray(state)       # device -> host pull, one hop away
+
+# paxlint: resident-loop
+def run_resident_dispatch(state):
+    y = helper(state)              # transitive: flagged in helper
+    jax.block_until_ready(state)   # blocks the measured loop
+    n = state.sum().item()         # host sync
+    return y, n
+'''
+
+RESIDENT_CLEAN = '''
+import functools
+
+import jax
+import jax.numpy as jnp
+
+def kernel(state):
+    k = int(7)                     # literal coercion: not a readback
+    return jnp.where(state > 0, state, -state) + k
+
+# paxlint: resident-loop
+def run_resident_dispatch(state):
+    step = functools.partial(kernel)
+    out = jax.vmap(step)(state)    # bare-reference edge, still clean
+    return out
+
+def host_tool(x):
+    import numpy as np
+    return np.asarray(x)           # unmarked host code may sync freely
+'''
+
+
+def test_resident_loop_fires_on_seeded_violations():
+    vs = lint_src("minpaxos_tpu/parallel/fx.py", RESIDENT_BAD,
+                  "resident-loop")
+    msgs = "\n".join(v.msg for v in vs)
+    assert len(vs) == 3, vs
+    assert any(v.path.endswith("fx.py") and v.line == 6 for v in vs), \
+        "np.asarray must be flagged in the REACHED helper, not the root"
+    for needle in ("np.asarray", "block_until_ready", ".item()"):
+        assert needle in msgs, f"missing {needle}: {msgs}"
+
+
+def test_resident_loop_quiet_on_clean_idiom_and_unmarked_host_code():
+    assert lint_src("minpaxos_tpu/parallel/ok.py", RESIDENT_CLEAN,
+                    "resident-loop") == []
+
+
+def test_resident_loop_scalar_readback_needs_suppression():
+    """int()/float() in a MARKED dispatch wrapper is a scalar readback
+    and must carry the sanctioning suppression; with it, clean."""
+    src = '''
+# paxlint: resident-loop
+def run_resident_dispatch(committed):
+    return int(committed)
+'''
+    vs = lint_src("minpaxos_tpu/parallel/rb.py", src, "resident-loop")
+    assert len(vs) == 1 and "scalar readback" in vs[0].msg
+    ok = src.replace(
+        "return int(committed)",
+        "return int(committed)  # paxlint: disable=resident-loop -- ok")
+    assert lint_src("minpaxos_tpu/parallel/rb.py", ok,
+                    "resident-loop") == []
+
+
+def test_resident_loop_follows_cross_module_and_method_edges():
+    """The real topology: a marked METHOD calling a jitted module
+    function in another module that hides the sync."""
+    kernel = '''
+import numpy as np
+
+def fused_dispatch(state):
+    return np.asarray(state)
+'''
+    wrapper = '''
+from minpaxos_tpu.ops.fused import fused_dispatch
+
+class Cluster:
+    # paxlint: resident-loop
+    def run_resident(self, k):
+        return fused_dispatch(self.ss)
+'''
+    vs = run_passes(Project({
+        "minpaxos_tpu/ops/fused.py": kernel,
+        "minpaxos_tpu/parallel/wrap.py": wrapper,
+    }), ("resident-loop",))
+    assert len(vs) == 1 and vs[0].path.endswith("fused.py"), vs
+    assert "run_resident" in vs[0].msg  # names the responsible root
+
+
+def test_resident_loop_real_suppression_is_load_bearing():
+    """The ONE sanctioned per-dispatch scalar readback in the real
+    tree (ShardedCluster.run_resident) is actually guarded: stripping
+    its suppression must produce exactly the int() readback
+    violations, nothing else."""
+    files = {p: (REPO / p).read_text() for p in (
+        "minpaxos_tpu/parallel/sharded.py",
+        "minpaxos_tpu/ops/workload.py",
+        "minpaxos_tpu/models/cluster.py",
+        "minpaxos_tpu/models/minpaxos.py",
+    )}
+    marker = "# paxlint: disable=resident-loop -- sanctioned scalar readback"
+    assert marker in files["minpaxos_tpu/parallel/sharded.py"]
+    assert run_passes(Project(files), ("resident-loop",)) == []
+    files["minpaxos_tpu/parallel/sharded.py"] = files[
+        "minpaxos_tpu/parallel/sharded.py"].replace(marker, "#")
+    vs = run_passes(Project(files), ("resident-loop",))
+    assert vs and all(v.rule == "resident-loop"
+                      and "scalar readback" in v.msg for v in vs), vs
+
+
 _CLI_SEEDS = {
     "trace-hazard": ("minpaxos_tpu/models/seed.py", TRACE_BAD),
     "recompile-hazard": ("minpaxos_tpu/ops/seed.py",
@@ -857,6 +976,7 @@ _CLI_SEEDS = {
                      "    except Exception:\n        pass\n"),
     "quorum-certificate": ("minpaxos_tpu/models/flex.py", QUORUM_BAD),
     "lock-order": ("minpaxos_tpu/runtime/transport.py", LOCK_CYCLE),
+    "resident-loop": ("minpaxos_tpu/parallel/seed.py", RESIDENT_BAD),
 }
 
 

@@ -256,6 +256,83 @@ def pipeline_decompose(window: int = 512, iters: int = 40) -> None:
           f"(1.0 = host wall fully hidden under device compute)")
 
 
+def resident_decompose(g: int = 2, w: int = 1024, p: int = 256,
+                       k: int = 8, iters: int = 10) -> None:
+    """Per-dispatch decomposition of the device-resident measured loop
+    (ISSUE 8 satellite — mirrors ``--pipeline`` for the pipelined tick
+    loop): split one resident dispatch into
+
+    * ``enqueue``: host wall to launch the k-round fused dispatch
+      (jit call overhead + async submit; nothing transferred in),
+    * ``device compute``: enqueue + block, no readback,
+    * ``scalar readback``: the two-scalar cursor read after compute
+      (the ONLY sanctioned host sync in the steady state),
+
+    and A/B it against the legacy host-in-the-loop dispatch
+    (``run_fused``: same k rounds, then the [k, G] cursor-history
+    transfer + blocking conversion). A regression in the resident path
+    shows up as the readback line growing past scalar size, or the
+    enqueue line growing a recompile."""
+    import jax.numpy as jnp
+
+    from minpaxos_tpu.parallel.sharded import (
+        ShardedCluster,
+        sharded_run_resident,
+    )
+
+    cu = max(32, p // 4)
+    cfg = MinPaxosConfig(n_replicas=5, window=w, inbox=p + 2 * cu + 128,
+                         exec_batch=p, kv_pow2=10, catchup_rows=cu,
+                         recovery_rows=64)
+    sc = ShardedCluster(cfg, g, ext_rows=p, key_space=1 << 8)
+    sc.elect(0)
+    sc.begin_resident()
+    sc.run_resident(k, p)  # warm/compile the resident dispatch
+
+    def dispatch_async():
+        out = sharded_run_resident(
+            sc.cfg, sc.n_shards, sc.ext_rows, k, sc.ss, sc._inject_round,
+            sc._lat_hist, jnp.int32(p), jnp.int32(sc.leader),
+            jnp.int32(sc._seed), jnp.int32(sc.seed), sc._step_impl,
+            sc.key_space, 1)
+        sc.ss, sc._inject_round, sc._lat_hist = out[0], out[1], out[2]
+        sc._seed += k
+        return out[3], out[4]
+
+    legs = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        committed, in_flight = dispatch_async()
+        t1 = time.perf_counter()
+        jax.block_until_ready(committed)
+        t2 = time.perf_counter()
+        c, f = int(committed), int(in_flight)  # the scalar readback
+        t3 = time.perf_counter()
+        legs.append(((t1 - t0) * 1e3, (t2 - t1) * 1e3, (t3 - t2) * 1e3))
+    legs.sort(key=lambda t: sum(t))
+    enq, comp, rb = legs[len(legs) // 2]
+
+    # legacy comparison: same rounds, host-in-the-loop history readback
+    sc2 = ShardedCluster(cfg, g, ext_rows=p, key_space=1 << 8)
+    sc2.elect(0)
+    sc2.run_fused(k, p)  # warm
+
+    def legacy():
+        u, c = sc2.run_fused(k, p)  # np.asarray blocks inside
+
+    legacy_ms = _time(legacy, iters)
+    total = enq + comp + rb
+    print(f"\n-- resident-loop decomposition, g={g} W={w} p={p} k={k} --")
+    print(f"  enqueue (jit call + async submit) {enq:8.3f} ms/dispatch")
+    print(f"  device compute ({k} fused rounds)  {comp:8.3f} ms/dispatch")
+    print(f"  scalar readback (2 cursors)       {rb:8.3f} ms/dispatch")
+    print(f"  resident dispatch total           {total:8.3f} ms "
+          f"({total / k:.3f} ms/round)")
+    print(f"  legacy run_fused ([k,G] readback) {legacy_ms:8.3f} ms "
+          f"({legacy_ms / k:.3f} ms/round)")
+    print(f"  host-loop tax amortized away      {legacy_ms - total:8.3f} ms/dispatch")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--window", type=int, default=4096)
@@ -271,11 +348,21 @@ def main() -> None:
                          "(enqueue/compute/readback/host walls + "
                          "overlap efficiency) and exit — the per-tick "
                          "evidence behind the pipelined tick loop")
+    ap.add_argument("--resident", action="store_true",
+                    help="run ONLY the resident-loop decomposition "
+                         "(enqueue/device-compute/scalar-readback per "
+                         "dispatch + legacy host-loop A/B) and exit — "
+                         "the per-dispatch evidence behind the "
+                         "device-resident measured loop")
     args = ap.parse_args()
 
     if args.pipeline:
         print(f"backend: {jax.devices()[0].platform}", file=sys.stderr)
         pipeline_decompose(iters=args.iters)
+        return
+    if args.resident:
+        print(f"backend: {jax.devices()[0].platform}", file=sys.stderr)
+        resident_decompose(iters=args.iters)
         return
 
     print(f"backend: {jax.devices()[0].platform}", file=sys.stderr)

@@ -39,7 +39,16 @@ python tools/obs_smoke.py || exit 1
 echo "== paxmc smoke (bounded model check: 3 protocols + quorum mutant) =="
 env JAX_PLATFORMS=cpu python tools/mc.py --smoke || exit 1
 
-# paxchaos smoke fourth: two fixed-seed fault schedules (partition-heal
+# shape-ladder + resident-loop smoke fourth: two tiny (g, w, p, k)
+# points through the fully device-resident measured loop — commits
+# flow, the drain is exact (in-flight == 0: the latency-accounting
+# contract), the on-device latency histogram is populated, and the
+# autotuner picks a winner (PERF.md resident-loop section). Budgeted
+# <= 60 s including the jit compile of both points.
+echo "== shape-ladder smoke (2-point resident-loop sweep, drain-exact) =="
+env JAX_PLATFORMS=cpu python tools/shape_ladder.py --smoke || exit 1
+
+# paxchaos smoke fifth: two fixed-seed fault schedules (partition-heal
 # + 10% loss/reorder) against a real in-process cluster, checked with
 # the SAME invariant predicates the model checker just proved at small
 # bounds (ROBUSTNESS.md). Budget clock starts after the first run so

@@ -1,0 +1,240 @@
+"""Shape-ladder autotuner: find the throughput-optimal (shards x
+window x proposals x k) point for the device-resident consensus loop.
+
+The bench's shapes were hand-picked for SURVIVAL (the biggest shape a
+fragile remote worker boots), not throughput. This tool replaces that
+guess with a measurement: it runs the resident fused loop
+(parallel/sharded.py ``sharded_run_resident``) over a small grid of
+(g, w, p, k) points per protocol, times a few back-to-back dispatches
+at each, verifies every point drains exactly (assigned == committed,
+the latency-accounting contract), and reports the winner. ``bench.py
+--ladder`` consumes the JSON and measures its full record at the
+winning point; the whole sweep lands in the bench artifact so a record
+documents the alternatives its shape beat.
+
+Grid design (PR 8 ablation, PERF.md): commits/round are capped by p
+(proposal rows per shard per round) but only while the window stays >=
+~4x p deep (the commit pipeline is 3 deliveries); inbox capacity costs
+~50 us/row/round on the measured CPU host, so catchup_rows uses
+economy sizing p/4 instead of a fixed 128 (ladder points skip the
+bench's fault leg; sizing policy is imported from bench.py so the
+winner re-measures under exactly the sweep's config — key space and
+KV capacity scale with p, keeping the stride-walk keys
+duplicate-free at every point); and shard counts beyond the device
+count only dilute one core's time, so g sweeps {1, device_count}
+with the shard axis meshed over real devices when there is more than
+one.
+
+Budget: points are measured best-first under ``--budget-s``; points
+dropped for budget are LISTED in the output (never silently) and the
+already-measured prefix still yields a winner.
+
+    JAX_PLATFORMS=cpu python tools/shape_ladder.py [--json out.json]
+    python tools/shape_ladder.py --smoke   # 2 tiny points, CI gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the sweep is CPU-friendly by default; let an operator pin the
+# backend exactly as for the other tools
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+# sizing policy is SHARED with bench.py (single definition): the
+# measured winner must re-run under exactly the config that won the
+# sweep — catch-up/inbox rows, key space, AND KV capacity. Ladder
+# points use the economy (fault=False) catch-up sizing; the bench's
+# kill/recover leg runs at its default shape with fault-viable sizing.
+from bench import cpu_catchup_rows, cpu_key_space, cpu_kv_pow2  # noqa: E402
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig  # noqa: E402
+from minpaxos_tpu.models.paxos import classic_config  # noqa: E402
+from minpaxos_tpu.parallel import make_mesh  # noqa: E402
+from minpaxos_tpu.parallel.sharded import ShardedCluster  # noqa: E402
+
+
+def point_config(protocol: str, w: int, p: int) -> MinPaxosConfig:
+    cu = cpu_catchup_rows(p, fault=False)
+    kw = dict(n_replicas=5, window=w, inbox=p + 2 * cu + 64 + 64,
+              exec_batch=p, kv_pow2=cpu_kv_pow2(p), catchup_rows=cu,
+              recovery_rows=64)
+    if protocol == "classic":
+        return classic_config(**kw)
+    if protocol == "mencius":
+        # per-step commit-broadcast chunk must beat the per-owner
+        # proposal rate (bench.py mencius side config rationale)
+        kw["catchup_rows"] = max(kw["catchup_rows"], 2 * p)
+        kw["inbox"] = max(kw["inbox"], 4 * p)
+        kw["noop_delay"] = 8
+    return MinPaxosConfig(**kw)
+
+
+def measure_point(protocol: str, g: int, w: int, p: int, k: int,
+                  dispatches: int = 3, key_space: int | None = None,
+                  shard_devices: int = 1, seed: int = 0) -> dict:
+    """Time the resident loop at one (g, w, p, k) point: warm one
+    dispatch, run ``dispatches`` back-to-back (two-scalar readbacks
+    only), then drain and REQUIRE exactness (in-flight == 0) — a point
+    that cannot drain is not a legal operating point, however fast."""
+    cfg = point_config(protocol, w, p)
+    if key_space is None:
+        key_space = cpu_key_space(p)
+    mesh = None
+    if shard_devices > 1:
+        mesh = make_mesh(n_shard_devices=shard_devices,
+                         n_replica_devices=1)
+    t_build = time.perf_counter()
+    sc = ShardedCluster(cfg, g, ext_rows=p, mesh=mesh, protocol=protocol,
+                        key_space=key_space, seed=seed)
+    if protocol != "mencius":
+        sc.elect(0)
+    sc.begin_resident()
+    sc.run_resident(k, p)  # warm/compile
+    compile_s = time.perf_counter() - t_build
+    c0, _ = sc.run_resident(k, p)
+    t0 = time.perf_counter()
+    committed = c0
+    for _ in range(dispatches):
+        committed, _ = sc.run_resident(k, p)
+    wall = time.perf_counter() - t0
+    measured = committed - c0  # commits inside the timed window only
+    in_flight = None
+    for _ in range(8):
+        _, in_flight = sc.run_resident(k, 0)
+        if in_flight == 0:
+            break
+    hist = sc.end_resident()
+    return {
+        "protocol": protocol,
+        "g": g, "w": w, "p": p, "k": k,
+        "shard_devices": shard_devices,
+        "catchup_rows": cfg.catchup_rows,
+        "inst_per_sec": round(measured / wall, 1),
+        "ms_per_round": round(wall / (dispatches * k) * 1e3, 3),
+        "committed": int(measured),
+        "drained_exact": in_flight == 0,
+        "latency_samples": int(hist.sum()),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def default_grid(protocol: str, device_count: int) -> list[tuple]:
+    """(g, w, p, k, shard_devices) points, best-guess-first so a tight
+    budget still measures the likely winners."""
+    d = max(1, device_count)
+    pts: list[tuple] = []
+    for p in (1024, 512, 256):
+        for g, sd in ([(d, d)] if d > 1 else []) + [(1, 1)]:
+            pts.append((g, 4 * p, p, 8, sd))
+    # k sensitivity at the expected winner
+    pts.append((d if d > 1 else 1, 4096, 1024, 16, d))
+    # the PR-7 hand-picked survival shape, as the sweep's own baseline
+    pts.append((8, 512, 64, 8, 1))
+    return pts
+
+
+SMOKE_POINTS = [(1, 128, 16, 2, 1), (2, 128, 16, 2, 1)]
+
+
+def sweep(protocol: str = "minpaxos", budget_s: float = 900.0,
+          points: list[tuple] | None = None, dispatches: int = 3,
+          seed: int = 0) -> dict:
+    t_start = time.perf_counter()
+    grid = points if points is not None else default_grid(
+        protocol, jax.device_count())
+    results, dropped = [], []
+    for pt in grid:
+        g, w, p, k, sd = pt
+        if time.perf_counter() - t_start > budget_s and results:
+            dropped.append(list(pt))
+            continue
+        try:
+            rec = measure_point(protocol, g, w, p, k,
+                                dispatches=dispatches, shard_devices=sd,
+                                seed=seed)
+        except Exception as e:  # noqa: BLE001 — a too-big point must
+            # not kill the sweep; the failure is recorded, not hidden
+            rec = {"protocol": protocol, "g": g, "w": w, "p": p, "k": k,
+                   "shard_devices": sd, "error": repr(e)[:200]}
+        results.append(rec)
+        print(f"[ladder] {rec}", file=sys.stderr, flush=True)
+    legal = [r for r in results
+             if r.get("drained_exact") and not r.get("error")]
+    winner = max(legal, key=lambda r: r["inst_per_sec"]) if legal else None
+    return {
+        "protocol": protocol,
+        "backend": jax.devices()[0].platform,
+        "device_count": jax.device_count(),
+        "budget_s": budget_s,
+        "points": results,
+        "dropped_for_budget": dropped,
+        "winner": winner,
+    }
+
+
+def smoke() -> int:
+    """CI gate (tools/run_tier1.sh): two tiny points through the full
+    resident path — commits flow, the drain is exact, the latency
+    sample is complete, and g=2 agrees with g=1 per shard. Budget <=60s
+    after compile; asserts are the contract."""
+    t0 = time.perf_counter()
+    rec = sweep(points=SMOKE_POINTS, dispatches=2, budget_s=300.0)
+    wall = time.perf_counter() - t0
+    ok = True
+    for r in rec["points"]:
+        if r.get("error") or not r.get("drained_exact"):
+            print(f"FAIL: ladder point did not drain exactly: {r}")
+            ok = False
+            continue
+        if r["committed"] <= 0 or r["latency_samples"] <= 0:
+            print(f"FAIL: ladder point made no progress: {r}")
+            ok = False
+    if rec["winner"] is None:
+        print("FAIL: no legal winner among smoke points")
+        ok = False
+    post_compile = wall - sum(r.get("compile_s", 0) for r in rec["points"])
+    print(f"shape-ladder smoke: {len(rec['points'])} points, "
+          f"winner g={rec['winner']['g']} w={rec['winner']['w']} "
+          f"p={rec['winner']['p']} k={rec['winner']['k']} "
+          f"({rec['winner']['inst_per_sec']:.0f} inst/s), "
+          f"{wall:.1f}s wall ({post_compile:.1f}s post-compile)"
+          if ok else "shape-ladder smoke: FAILED")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--protocol", default="minpaxos",
+                    choices=("minpaxos", "classic", "mencius"))
+    ap.add_argument("--budget-s", type=float, default=900.0)
+    ap.add_argument("--dispatches", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", default=None,
+                    help="write the sweep record to this path")
+    ap.add_argument("--smoke", action="store_true",
+                    help="2-point tiny-shape CI gate (run_tier1.sh)")
+    args = ap.parse_args()
+    if args.smoke:
+        return smoke()
+    rec = sweep(args.protocol, args.budget_s, dispatches=args.dispatches,
+                seed=args.seed)
+    out = json.dumps(rec, indent=2)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(out + "\n")
+    print(out)
+    if rec["winner"] is None:
+        print("no legal (exactly-drained) point measured", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
