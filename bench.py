@@ -43,6 +43,16 @@ dead for ``dead_dispatches`` dispatches, then revived; the record
 reports the throughput dip and the rounds-to-reheal (revived replica's
 min frontier catching the leader's frontier at revive time).
 
+Round 6, PR 9 (paxray): the resident loop is observable again —
+``BENCH_TELEMETRY=1`` (default) arms an on-device telemetry ring (one
+row per round: committed delta, in-flight, injected/inbox/claim rows,
+election flag) read back once after the measured window; ``--trace
+out.json`` merges the per-dispatch host walls with the device rounds
+into one validated Perfetto file; ``--xprof DIR`` is the CLI alias
+for ``MP_BENCH_PROFILE`` (jax.profiler capture around the measured
+phase, the TPU-relay decomposition knob). Per-substep cost
+attribution lives in ``tools/profile_substeps.py``.
+
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
 is against the driver's north star: 1M concurrent instances at <10ms
 p50 on a v5e-8 == 12.5M committed inst/s/chip.
@@ -81,6 +91,17 @@ RESIDENT = os.environ.get("BENCH_RESIDENT", "1") != "0"
 # workload PRNG base key — the whole proposal stream is a pure
 # function of (seed, round), bit-reproducible across runs/hosts
 WORKLOAD_SEED = int(os.environ.get("MP_BENCH_SEED", "0"))
+
+# BENCH_TELEMETRY=0 disables the paxray on-device telemetry ring
+# (ISSUE 9): with it on (default), the resident scan accumulates one
+# int32 row per round (committed delta, in-flight, injected/inbox/
+# claim rows, election-vs-steady flag — obs/recorder.py layout) in a
+# donated device buffer read back ONCE after the measured window, so
+# the two-scalars-per-dispatch residency contract is untouched.
+# Telemetry never writes protocol state — committed results are
+# byte-identical on/off (tests/test_paxray.py) and the dispatch wall
+# must agree within 2% (tools/obs_smoke.py --resident gate).
+TELEMETRY = os.environ.get("BENCH_TELEMETRY", "1") != "0"
 
 
 def _progress(msg: str) -> None:
@@ -244,6 +265,24 @@ def cpu_kv_pow2(p: int) -> int:
     same saturation headroom the fixed (2^8 keys, 2^10 table) CPU
     default always had."""
     return max(10, (cpu_key_space(p) - 1).bit_length() + 2)
+
+
+def overflow_warning(overflow: int) -> str | None:
+    """The loud-stdout message for a saturated latency histogram
+    (None when clean). A nonzero overflow bin means the tail was
+    CLIPPED: every slot slower than the histogram range was counted
+    at the last bin, so the reported percentiles understate the true
+    tail — a record whose stamp alone carried this got trusted once
+    too often. Printed to STDOUT next to the JSON record (consumers
+    filter on lines starting with '{', so the warning can't corrupt
+    parsing) and echoed to stderr progress."""
+    if not overflow:
+        return None
+    return (f"WARNING: latency_hist_overflow={overflow} — {overflow} "
+            f"committed slots exceeded the histogram range; the "
+            f"reported p50/p99 come from a SATURATED histogram and "
+            f"understate the true tail. Raise lat_bins or shrink the "
+            f"measured window.")
 
 
 def _latency_from_hist(hist, round_ms):
@@ -443,8 +482,16 @@ def measure(shape: tuple[int, int, int, int] | None = None,
 
         # -- warmup / compile (k, k_dead and k=1 variants of whichever
         # loop this run measures) --
+        # paxray telemetry ring capacity: every round the measured
+        # window can run (healthy + dead + recovery + full drain
+        # budget), so the post-window readback never wraps. Sized at
+        # warmup too: the telemetry buffer's shape is part of the
+        # compiled dispatch, and the measured phase must reuse the
+        # warmed compilation.
+        tel_cap = ((healthy_d + rec_d + 8) * k + k_dead + 8) if TELEMETRY \
+            else 0
         if RESIDENT:
-            sc.begin_resident()
+            sc.begin_resident(telemetry_rounds=tel_cap)
             sc.run_resident(k, p, substeps=SS_N)
             sc.run_resident(k_dead, p, substeps=SS_N)
             sc.run_resident(1, p, substeps=SS_N)
@@ -488,6 +535,37 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             bounds=(50.0, 100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0,
                     15000.0, 60000.0))
 
+        # -- unified timeline capture (--trace / MP_BENCH_TRACE,
+        # paxray): per-dispatch monotonic_ns walls + a host flight
+        # recorder row per dispatch, so the post-window telemetry
+        # readback can be rendered as device-round slices on the SAME
+        # clock the TCP runtime's recorder stamps — one merged,
+        # validated Perfetto file. Two clock reads per dispatch; the
+        # resident path itself is untouched.
+        trace_path = os.environ.get("MP_BENCH_TRACE")
+        disp_log: list = []
+        host_rec = None
+        if trace_path:
+            from minpaxos_tpu.obs.recorder import KIND_FUSED, FlightRecorder
+
+            host_rec = FlightRecorder(4096)
+
+        def _run_res(k_r: int, p_r: int):
+            r0 = sc._seed
+            t0 = time.monotonic_ns()
+            c, f = sc.run_resident(k_r, p_r, substeps=SS_N)
+            t1 = time.monotonic_ns()
+            disp_log.append({"t0_ns": t0, "t1_ns": t1, "round0": r0,
+                             "k": k_r})
+            if host_rec is not None:
+                host_rec.record(
+                    t1, KIND_FUSED, k_r, rows_in=g * p_r * k_r,
+                    rows_out=0, frontier=c, backlog=f, drain_us=0,
+                    enqueue_us=0, readback_us=(t1 - t0) // 1000,
+                    overlap_us=0, persist_us=0, dispatch_us=0,
+                    reply_us=0, t_rb_ns=t1)
+            return c, f
+
         # -- measured phase 1: healthy, healthy_d fused dispatches --
         start_committed, _, _ = sc.committed()
         U, C = [], []
@@ -495,7 +573,7 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             # fresh bookkeeping: warmup-injected slots are excluded
             # from the latency sample exactly as the legacy path's
             # pre-phase cursor row excludes them
-            sc.begin_resident()
+            sc.begin_resident(telemetry_rounds=tel_cap)
             committed_cursor = start_committed
         else:
             u0, c0 = shard_cursors(cfg, sc.leader, sc.ss)
@@ -507,8 +585,7 @@ def measure(shape: tuple[int, int, int, int] | None = None,
                 if RESIDENT:
                     # back-to-back dispatches; the only per-dispatch
                     # host sync is the two-scalar cursor readback
-                    committed_cursor, _ = sc.run_resident(
-                        k, p, substeps=SS_N)
+                    committed_cursor, _ = _run_res(k, p)
                 else:
                     u, c = sc.run_fused(k, p, substeps=SS_N)
                     U.append(u)
@@ -584,7 +661,7 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             t0 = time.perf_counter()
             DU, DC = [], []
             if RESIDENT:
-                cd, _ = sc.run_resident(k_dead, p, substeps=SS_N)
+                cd, _ = _run_res(k_dead, p)
                 committed_dead = cd - committed_cursor
                 committed_cursor = cd
             else:
@@ -614,8 +691,7 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             t0 = time.perf_counter()
             for d in range(rec_d):
                 if RESIDENT:
-                    committed_cursor, _ = sc.run_resident(
-                        k, p, substeps=SS_N)
+                    committed_cursor, _ = _run_res(k, p)
                 else:
                     u, c = sc.run_fused(k, p, substeps=SS_N)
                     RU.append(u)
@@ -650,8 +726,7 @@ def measure(shape: tuple[int, int, int, int] | None = None,
         if RESIDENT:
             in_flight = None
             for _ in range(8):
-                committed_cursor, in_flight = sc.run_resident(
-                    k, 0, substeps=SS_N)
+                committed_cursor, in_flight = _run_res(k, 0)
                 drain_rounds += k
                 if in_flight == 0:
                     break
@@ -668,9 +743,14 @@ def measure(shape: tuple[int, int, int, int] | None = None,
         # -- latency over the WHOLE run (healthy + dead + recovery +
         # drain), in rounds at the healthy fused rate --
         hist_overflow = 0
+        tel_rows = None
         if RESIDENT:
             # the ONE full readback, after the measured window: exact
             # per-slot latencies from the device-accumulated histogram
+            # plus the paxray telemetry ring (read before end_resident
+            # disarms it)
+            if TELEMETRY:
+                tel_rows = sc.resident_telemetry()
             p50, p99, n_lat, hist_overflow = _latency_from_hist(
                 sc.end_resident(), round_ms)
             uncommitted = int(in_flight)
@@ -681,6 +761,12 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             p50, p99, n_lat, uncommitted = _latency_rounds(
                 uptos, crts, round_ms)
             committed_total = int((uptos[-1] + 1).sum())
+        warn = overflow_warning(hist_overflow)
+        if warn:
+            # LOUD, on stdout next to the record itself (the artifact
+            # stamp alone was missable)
+            print(warn, flush=True)
+            _progress(warn)
         result = {
             "metric": "committed_instances_per_sec",
             "value": round(throughput, 1),
@@ -712,6 +798,14 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             # via .get("resident", False).
             "resident": RESIDENT,
             "donation": DONATION,
+            # paxray provenance: whether the device telemetry ring was
+            # armed (BENCH_TELEMETRY) and how many rounds it captured —
+            # the on/off dispatch wall is gated within 2% by
+            # tools/obs_smoke.py --resident, so enabled=True never
+            # marks a slower record
+            "telemetry": {"enabled": TELEMETRY and RESIDENT,
+                          "rounds_captured":
+                              0 if tel_rows is None else int(len(tel_rows))},
             "workload": {"generator": "threefry2x32",
                          "seed": WORKLOAD_SEED},
             "shape": {"n_shards": g, "window": w, "proposals": p,
@@ -731,6 +825,41 @@ def measure(shape: tuple[int, int, int, int] | None = None,
         }
         if ladder is not None:
             result["ladder"] = ladder
+
+        # -- unified Perfetto timeline (--trace PATH): host dispatch
+        # slices (flight-recorder rows, pid 0) merged with device-round
+        # slices + frontier/in-flight counter tracks rendered from the
+        # post-window telemetry readback (reserved DEVICE_PID) — one
+        # validated file a resident dispatch and the TCP runtime share.
+        if trace_path and not disp_log:
+            # the timeline instruments the RESIDENT dispatch loop; in
+            # BENCH_RESIDENT=0 legacy mode nothing was captured — say
+            # so instead of writing an empty file that looks like a
+            # capture
+            _progress("--trace: no dispatches captured (tracing "
+                      "instruments the resident loop; BENCH_RESIDENT=0 "
+                      "runs the legacy path) — no trace written")
+        elif trace_path:
+            from minpaxos_tpu.obs.recorder import (
+                chrome_trace,
+                device_round_events,
+                validate_chrome_trace,
+            )
+
+            events = host_rec.to_events(pid=0)
+            if tel_rows is not None and len(tel_rows):
+                events += device_round_events(tel_rows, disp_log, g)
+            trace = chrome_trace(events)
+            errs = validate_chrome_trace(trace)
+            if errs:
+                _progress(f"trace INVALID ({len(errs)} schema errors): "
+                          f"{errs[:3]}")
+            else:
+                with open(trace_path, "w") as f:
+                    json.dump(trace, f)
+                _progress(f"wrote {len(events)} trace events to "
+                          f"{trace_path} (open in ui.perfetto.dev)")
+                result["trace_file"] = trace_path
 
         # -- BASELINE side configs 2-4 (config 1, the TCP runtime, is
         # measured separately: bench_tcp.py writes BENCH_TCP.json) --
@@ -891,6 +1020,25 @@ def main() -> None:
     wedging the driver)."""
     import os
 
+    # observability knobs, normalized to env so every child process
+    # (ladder rungs, --ladder measure child) inherits them:
+    # --xprof DIR wraps the measured phase in a jax.profiler trace
+    # (TPU-relay runs: split device compute from tunnel/dispatch tax
+    # offline — alias for MP_BENCH_PROFILE); --trace PATH writes the
+    # merged host+device Perfetto timeline (paxray).
+    argv = sys.argv[1:]
+    for flag, env_key in (("--xprof", "MP_BENCH_PROFILE"),
+                          ("--trace", "MP_BENCH_TRACE")):
+        if flag in argv:
+            i = argv.index(flag)
+            # a following flag must not be silently consumed as the
+            # path (`--trace --ladder` would write a file named
+            # "--ladder" and still enter ladder mode)
+            if i + 1 >= len(argv) or argv[i + 1].startswith("-"):
+                _progress(f"{flag} needs a path argument")
+                sys.exit(2)
+            os.environ[env_key] = argv[i + 1]
+
     if os.environ.get("MP_BENCH_CHILD"):
         ladder_rec = None
         if os.environ.get("MP_BENCH_LADDER_FILE"):
@@ -938,6 +1086,12 @@ def main() -> None:
                    # bigger rungs measure throughput without the leg
                    # that crashed the remote worker at 524k (round 5)
                    MP_BENCH_FAULT="1" if i == 0 else "0")
+        if env.get("MP_BENCH_TRACE"):
+            # one trace file PER RUNG: a later (possibly rejected)
+            # rung overwriting the winning rung's trace would leave
+            # the published record's trace_file stamp pointing at a
+            # timeline from a different measurement
+            env["MP_BENCH_TRACE"] = f"{env['MP_BENCH_TRACE']}.rung{i}"
         _progress(f"ladder {i}: shape {shape}")
         try:
             proc = subprocess.run(

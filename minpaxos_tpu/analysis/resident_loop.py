@@ -39,6 +39,19 @@ function is held to:
 
 Unmarked functions are untouched — host orchestration code is free to
 sync; the rule guards only the paths that claim residency.
+
+paxray telemetry readback (ISSUE 9): the resident dispatch now also
+threads the donated telemetry ring, and its READBACK SITE
+(``ShardedCluster.resident_telemetry`` → ``np.asarray``) is
+deliberately UNMARKED post-window host code — the same discipline as
+``end_resident``. This pass is what keeps that discipline structural:
+the telemetry row construction traced inside the scan
+(ops/telemetry.py) is reached from the marked root and held to the
+no-sync rules, while any future call of the readback FROM a marked
+root (e.g. someone "just peeking" at the ring between measured
+dispatches) is flagged through the ``self.method()`` edge as an
+``np.asarray`` pull — tests/test_paxlint.py pins exactly that
+topology.
 """
 
 from __future__ import annotations

@@ -36,14 +36,19 @@ from minpaxos_tpu.obs.metrics import (
     TICK_MS_BUCKETS,
 )
 from minpaxos_tpu.obs.recorder import (
+    DEVICE_PID,
     FlightRecorder,
     KIND_FULL,
     KIND_FUSED,
     KIND_IDLE_SKIP,
     KIND_NAMES,
     KIND_NARROW,
+    N_TEL_FIELDS,
     SCHEMA_VERSION,
+    TEL_FIELD_NAMES,
     chrome_trace,
+    device_round_events,
+    telemetry_valid_rows,
     validate_chrome_trace,
 )
 
@@ -51,5 +56,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "TICK_MS_BUCKETS", "FlightRecorder", "KIND_FULL", "KIND_FUSED",
     "KIND_NARROW", "KIND_IDLE_SKIP", "KIND_NAMES", "SCHEMA_VERSION",
-    "chrome_trace", "validate_chrome_trace",
+    "DEVICE_PID", "N_TEL_FIELDS", "TEL_FIELD_NAMES",
+    "chrome_trace", "device_round_events", "telemetry_valid_rows",
+    "validate_chrome_trace",
 ]

@@ -41,8 +41,13 @@ import numpy as np
 #: position (v1: 12 fields with a single step_us; v2: enqueue_us /
 #: readback_us / overlap_us split, 14 fields; v3: trailing
 #: chaos_faults — cumulative paxchaos injected-fault count at this
-#: tick, so Perfetto shows fault bursts against tick regimes)
-SCHEMA_VERSION = 3
+#: tick, so Perfetto shows fault bursts against tick regimes; v4:
+#: paxray device-round tracks — the resident loop's post-window
+#: telemetry readback rendered as round slices + counter tracks under
+#: the reserved DEVICE_PID, mergeable with host flight-recorder events
+#: into one validated timeline. The tick-row layout itself is
+#: unchanged from v3.)
+SCHEMA_VERSION = 4
 
 # dispatch regimes (runtime/replica.py classifies one per tick:
 # narrow > fused > full; idle-skip never reaches the device)
@@ -77,6 +82,104 @@ _HOST_PHASES = (("persist", F_PERSIST_US), ("dispatch", F_DISPATCH_US),
                 ("reply", F_REPLY_US))
 
 _EVENT_PHASES = frozenset("XBEiICMsnbe")  # trace-event ph codes we accept
+
+# ---------------------------------------------------------------- paxray
+# Device-side telemetry for the resident measured loop (schema v4).
+# The resident scan (parallel/sharded.py sharded_run_resident)
+# accumulates ONE int32 row per protocol round in a donated device
+# buffer; the bench reads the buffer back exactly once after the
+# measured window and renders it here as Perfetto tracks. The layout
+# is canonical HERE (obs stays numpy-only, importable by paxtop with
+# no JAX) and ops/telemetry.py — the jnp row constructor traced inside
+# the scan — imports it, so the two sides can never drift.
+
+#: reserved pid for device-round tracks in merged traces. Host
+#: flight-recorder events use replica-id pids (small ints); the
+#: validator enforces that ``device_round`` events carry exactly this
+#: pid so a merged file keeps one unambiguous device track group.
+DEVICE_PID = 9999
+
+# telemetry-row field layout (glossary in OBSERVABILITY.md):
+# round — absolute protocol round index (-1 = row never written);
+# committed_delta — instances committed this round, summed over
+#   shards at the cursor replica; in_flight — assigned-but-uncommitted
+#   after the round; assigned — log slots assigned this round;
+# injected_rows — live workload rows synthesized into the ext inbox;
+# inbox_rows — routed peer rows delivered from the pending inboxes;
+# claim_rows — rows applied through the KV claim path (executed-slot
+#   delta — the per-row cost driver ROADMAP item 1 names);
+# prepared_shards — shards whose cursor replica is a prepared leader
+#   (== n_shards is the steady state; below it, an election/recovery
+#   is in flight).
+(TEL_ROUND, TEL_COMMITTED, TEL_IN_FLIGHT, TEL_ASSIGNED, TEL_INJECTED,
+ TEL_INBOX_ROWS, TEL_CLAIM_ROWS, TEL_PREPARED) = range(8)
+N_TEL_FIELDS = 8
+TEL_FIELD_NAMES = ("round", "committed_delta", "in_flight", "assigned",
+                   "injected_rows", "inbox_rows", "claim_rows",
+                   "prepared_shards")
+
+
+def telemetry_valid_rows(buf) -> np.ndarray:
+    """The written rows of a telemetry buffer readback, sorted by
+    round ([n, N_TEL_FIELDS] int). Unwritten ring rows are initialized
+    with round == -1 and are dropped here."""
+    rows = np.asarray(buf)
+    if rows.ndim != 2 or rows.shape[1] != N_TEL_FIELDS:
+        raise ValueError(f"telemetry buffer must be [n, {N_TEL_FIELDS}], "
+                         f"got {rows.shape}")
+    rows = rows[rows[:, TEL_ROUND] >= 0]
+    return rows[np.argsort(rows[:, TEL_ROUND], kind="stable")]
+
+
+def device_round_events(rows, dispatches: list[dict], n_shards: int,
+                        pid: int = DEVICE_PID) -> list[dict]:
+    """Chrome trace events for a post-window telemetry readback.
+
+    ``rows``: telemetry rows ([n, N_TEL_FIELDS]) — either the raw
+    ring buffer or ``resident_telemetry()``'s already-clean output;
+    the filter/sort applied here is idempotent, so pre-validated rows
+    pass through unchanged. ``dispatches``: the host loop's per-dispatch log —
+    dicts with ``t0_ns``/``t1_ns`` (monotonic_ns around the dispatch,
+    the same clock the flight recorder stamps) and ``round0``/``k``
+    (which rounds the dispatch ran) — device rounds have no wall
+    timestamps of their own, so each dispatch's rounds are laid evenly
+    across its measured wall interval. Emits one ``X`` round slice per
+    telemetry row (tid 0, cat ``device_round``, named by the
+    election/steady flag) plus ``device_frontier`` / ``device_in_flight``
+    counter tracks — the device-side twin of ``to_events``, sharing
+    its timeline so a resident dispatch and the TCP runtime merge into
+    one Perfetto file.
+    """
+    rows = telemetry_valid_rows(rows)
+    by_round = {int(r[TEL_ROUND]): r for r in rows}
+    events: list[dict] = []
+    frontier = 0
+    for d in sorted(dispatches, key=lambda d: d["t0_ns"]):
+        k = int(d["k"])
+        per_us = max((int(d["t1_ns"]) - int(d["t0_ns"])) / max(k, 1) / 1e3,
+                     1.0)
+        for j in range(k):
+            r = by_round.get(int(d["round0"]) + j)
+            if r is None:
+                continue  # telemetry off / ring overwrote this round
+            ts = int(d["t0_ns"]) / 1e3 + j * per_us
+            steady = int(r[TEL_PREPARED]) >= n_shards
+            frontier += int(r[TEL_COMMITTED])
+            events.append({
+                "name": f"round:{'steady' if steady else 'election'}",
+                "cat": "device_round", "ph": "X", "ts": ts,
+                "dur": per_us, "pid": pid, "tid": 0,
+                "args": {name: int(r[i])
+                         for i, name in enumerate(TEL_FIELD_NAMES)}})
+            t_end = ts + per_us
+            events.append({"name": "device_frontier", "ph": "C",
+                           "ts": t_end, "pid": pid, "tid": 0,
+                           "args": {"device_frontier": frontier}})
+            events.append({"name": "device_in_flight", "ph": "C",
+                           "ts": t_end, "pid": pid, "tid": 0,
+                           "args": {"device_in_flight":
+                                    int(r[TEL_IN_FLIGHT])}})
+    return events
 
 
 class FlightRecorder:
@@ -214,9 +317,13 @@ def validate_chrome_trace(trace) -> list[str]:
     plus the paxmon schema revision when stamped: a trace produced by
     a different ring layout (``otherData.paxmonSchemaVersion`` !=
     SCHEMA_VERSION) fails validation instead of silently mislabeling
-    phases in a viewer. Used by the tests, ``tools/obs_smoke.py`` and
-    paxtop's trace dump so a malformed export fails loudly at the
-    source, not in a viewer.
+    phases in a viewer. Schema v4 additionally pins the reserved-pid
+    contract of merged device+host traces: ``device_round`` slices
+    must carry DEVICE_PID and nothing else may squat on it — a host
+    event landing on the device pid (or vice versa) would interleave
+    the two timelines in a viewer. Used by the tests,
+    ``tools/obs_smoke.py`` and paxtop's trace dump so a malformed
+    export fails loudly at the source, not in a viewer.
     """
     errs: list[str] = []
     if not isinstance(trace, dict):
@@ -255,4 +362,12 @@ def validate_chrome_trace(trace) -> list[str]:
             if not isinstance(args, dict) or not args or not all(
                     isinstance(v, (int, float)) for v in args.values()):
                 errs.append(f"{where}: C event needs numeric args")
+        is_device = (ev.get("cat") == "device_round"
+                     or str(ev.get("name", "")).startswith("device_"))
+        if is_device and ev.get("pid") != DEVICE_PID:
+            errs.append(f"{where}: device track event must carry the "
+                        f"reserved pid {DEVICE_PID}, got {ev.get('pid')!r}")
+        if not is_device and ev.get("pid") == DEVICE_PID:
+            errs.append(f"{where}: pid {DEVICE_PID} is reserved for "
+                        f"device-round tracks")
     return errs

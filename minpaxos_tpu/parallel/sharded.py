@@ -39,6 +39,8 @@ from minpaxos_tpu.models.minpaxos import (
     init_replica,
     replica_step_impl,
 )
+from minpaxos_tpu.obs.recorder import N_TEL_FIELDS, telemetry_valid_rows
+from minpaxos_tpu.ops.telemetry import telemetry_row
 from minpaxos_tpu.ops.workload import (
     assemble_batch,
     propose_batch,
@@ -228,13 +230,13 @@ def sharded_run(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
 
 
 # paxlint: resident-loop
-@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 11, 12, 13),
-                   donate_argnums=(4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 12, 13, 14),
+                   donate_argnums=(4, 5, 6, 7))
 def sharded_run_resident(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
                          k_rounds: int, ss: ClusterState, inject_round,
-                         lat_hist, n_proposals, leader, round0, seed=0,
-                         step_impl=None, key_space: int = 1 << 20,
-                         substeps: int = 1):
+                         lat_hist, telemetry, n_proposals, leader, round0,
+                         seed=0, step_impl=None, key_space: int = 1 << 20,
+                         substeps: int = 1, tel_base=0):
     """k rounds in ONE dispatch with nothing read back but two scalars.
 
     The fully device-resident measured loop (ISSUE 8): workload rows
@@ -259,11 +261,21 @@ def sharded_run_resident(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
       sample (``np.repeat``) and the percentiles match the host path
       to the bit; the last bin is overflow and is reported, never
       silently clipped.
+    * ``telemetry`` [rounds, N_TEL_FIELDS] — the paxray ring (ISSUE
+      9): one int32 row per round (obs/recorder.py layout — committed
+      delta, in-flight, assigned/injected/inbox/claim row counts,
+      election/steady flag) written at index ``(round - tel_base) mod
+      rounds``, read back once after the measured window exactly like
+      the histogram. A ZERO-ROW buffer is the off switch: the writes
+      drop out of the trace at compile time, so ``BENCH_TELEMETRY=0``
+      runs the exact PR-8 dispatch. Telemetry never touches protocol
+      state — state is byte-identical on/off (tests/test_paxray.py).
 
-    Returns (ss', inject_round', lat_hist', committed_total,
-    in_flight) — the final two are the per-dispatch scalar cursors
-    (committed frontier for throughput progress, assigned-but-
-    uncommitted count for the drain loop's exactness check).
+    Returns (ss', inject_round', lat_hist', telemetry',
+    committed_total, in_flight) — the final two are the per-dispatch
+    scalar cursors (committed frontier for throughput progress,
+    assigned-but-uncommitted count for the drain loop's exactness
+    check).
     """
     step = replica_step_impl if step_impl is None else step_impl
     cursor_rep = jnp.maximum(leader, 0)
@@ -271,16 +283,25 @@ def sharded_run_resident(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
     w = cfg.window
     pos = jnp.arange(w, dtype=jnp.int32)[None, :]  # [1, W] ring positions
     ts = jnp.arange(k_rounds, dtype=jnp.int32)
+    tel_on = telemetry.shape[0] > 0  # trace-time: off = PR-8 dispatch
     # all k rounds' PRNG lanes, hoisted out of the scan (see sharded_run)
     keys, vals = workload_lanes(n_shards, ext_rows, round0 + ts, seed,
                                 key_space)
+    # steady/election flag source: MinPaxos-family states carry
+    # ``prepared`` [G, R]; Mencius has no elections (rotating
+    # ownership), so every round is steady. Structural, trace-time.
+    has_prepared = getattr(ss.states, "prepared", None) is not None
 
     def body(carry, xs):
-        ss, inj, hist = carry
+        ss, inj, hist, tel = carry
         t, key_t, val_t = xs
         r = round0 + t
         u_prev = ss.states.committed_upto[:, cursor_rep]
         c_prev = ss.states.crt_inst[:, cursor_rep]
+        if tel_on:
+            e_prev = ss.states.executed_upto[:, cursor_rep]
+            # routed peer rows awaiting delivery = this round's inbox
+            inbox_rows = (ss.pending.kind != 0).sum()
         ext = assemble_batch(cfg.n_replicas, n_shards, ext_rows,
                              n_proposals, leader, r, key_t, val_t)
         ss, _, _, _ = jax.vmap(cstep)(ss, ext)
@@ -300,13 +321,35 @@ def sharded_run_resident(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
         bins = jnp.clip(r - inj, 0, hist.shape[0] - 1)  # latency-1 rounds
         hist = hist.at[bins.reshape(-1)].add(
             sampled.reshape(-1).astype(hist.dtype))
-        return (ss, inj, hist), None
+        if tel_on:
+            prep = (ss.states.prepared[:, cursor_rep].sum(dtype=jnp.int32)
+                    if has_prepared else jnp.int32(n_shards))
+            # injected rows have a closed form (assemble_batch masks
+            # col < n_proposals, times G shards, times every owner in
+            # mencius mode) — cheaper than reducing ext.kind [G, R, M]
+            # on XLA-CPU, where per-op thunk cost is what the 2%
+            # obs_smoke overhead gate feels
+            injected = (n_shards * n_proposals
+                        * jnp.where(leader >= 0, 1, cfg.n_replicas))
+            row = telemetry_row(
+                round_idx=r,
+                committed_delta=(u_new - u_prev).sum(),
+                in_flight=(c_new - 1 - u_new).sum(),
+                assigned=(c_new - c_prev).sum(),
+                injected_rows=injected,
+                inbox_rows=inbox_rows,
+                claim_rows=(ss.states.executed_upto[:, cursor_rep]
+                            - e_prev).sum(),
+                prepared_shards=prep)
+            tel = jax.lax.dynamic_update_index_in_dim(
+                tel, row, jnp.mod(r - tel_base, telemetry.shape[0]), 0)
+        return (ss, inj, hist, tel), None
 
-    (ss, inject_round, lat_hist), _ = jax.lax.scan(
-        body, (ss, inject_round, lat_hist), (ts, keys, vals))
+    (ss, inject_round, lat_hist, telemetry), _ = jax.lax.scan(
+        body, (ss, inject_round, lat_hist, telemetry), (ts, keys, vals))
     upto = ss.states.committed_upto[:, cursor_rep]
     crt = ss.states.crt_inst[:, cursor_rep]
-    return (ss, inject_round, lat_hist,
+    return (ss, inject_round, lat_hist, telemetry,
             (upto + 1).sum(), (crt - 1 - upto).sum())
 
 
@@ -416,26 +459,39 @@ class ShardedCluster:
 
     # -- device-resident measured loop (ISSUE 8) --
 
-    def begin_resident(self, lat_bins: int = LATENCY_BINS) -> None:
+    def begin_resident(self, lat_bins: int = LATENCY_BINS,
+                       telemetry_rounds: int = 0) -> None:
         """Arm the resident loop's device-side bookkeeping: a fresh
         inject-round ring (all -1: slots already in flight are excluded
         from the latency sample, mirroring the host path's pre-phase
-        cursor row) and a zeroed latency histogram."""
+        cursor row), a zeroed latency histogram and — when
+        ``telemetry_rounds`` > 0 — the paxray telemetry ring (one row
+        per round, round column -1 = never written; 0 rows compiles
+        the telemetry-free PR-8 dispatch)."""
         self._inject_round = jnp.full(
             (self.n_shards, self.cfg.window), -1, jnp.int32)
         self._lat_hist = jnp.zeros(lat_bins, jnp.int32)
+        self._telemetry = jnp.full((telemetry_rounds, N_TEL_FIELDS), -1,
+                                   jnp.int32)
+        # ring indices are relative to the round counter at arming
+        # time, so re-arming (bench: warmup, then measured phase)
+        # restarts the ring at row 0
+        self._tel_base = int(self._seed)
         if self.mesh is not None:
             # ring rides the shard axis like the state; the histogram
-            # is a cross-shard reduction and is REPLICATED on the mesh
-            # — both placed up front to match the dispatch's output
-            # shardings exactly, or the second dispatch recompiles
-            # (~9 s observed: arm-time SingleDeviceSharding vs
-            # XLA's NamedSharding(P()) output for the histogram)
+            # and telemetry rows are cross-shard reductions and are
+            # REPLICATED on the mesh — all placed up front to match
+            # the dispatch's output shardings exactly, or the second
+            # dispatch recompiles (~9 s observed: arm-time
+            # SingleDeviceSharding vs XLA's NamedSharding(P()) output
+            # for the histogram)
             self._inject_round = jax.device_put(
                 self._inject_round,
                 NamedSharding(self.mesh, P("shard")))
             self._lat_hist = jax.device_put(
                 self._lat_hist, NamedSharding(self.mesh, P()))
+            self._telemetry = jax.device_put(
+                self._telemetry, NamedSharding(self.mesh, P()))
 
     # paxlint: resident-loop
     def run_resident(self, k_rounds: int, n_proposals: int,
@@ -443,15 +499,16 @@ class ShardedCluster:
         """k rounds in one dispatch, fully device-resident; returns
         (committed_total, in_flight) — the sanctioned per-dispatch
         scalar readbacks (progress cursor + drain check). Everything
-        else (state, inject ring, latency histogram) stays on device
-        in donated buffers until ``end_resident``."""
-        (self.ss, self._inject_round, self._lat_hist, committed,
-         in_flight) = sharded_run_resident(
+        else (state, inject ring, latency histogram, telemetry ring)
+        stays on device in donated buffers until ``end_resident``."""
+        (self.ss, self._inject_round, self._lat_hist, self._telemetry,
+         committed, in_flight) = sharded_run_resident(
             self.cfg, self.n_shards, self.ext_rows, k_rounds, self.ss,
-            self._inject_round, self._lat_hist,
+            self._inject_round, self._lat_hist, self._telemetry,
             jnp.int32(min(n_proposals, self.ext_rows)),
             jnp.int32(self.leader), jnp.int32(self._seed),
-            jnp.int32(self.seed), self._step_impl, self.key_space, substeps)
+            jnp.int32(self.seed), self._step_impl, self.key_space, substeps,
+            jnp.int32(self._tel_base))
         self._seed += k_rounds
         # the per-dispatch scalar readback — the ONLY host sync in the
         # measured steady state (paxlint's resident-loop rule keeps it
@@ -465,13 +522,25 @@ class ShardedCluster:
         hasn't run yet (still a post-window read, never per-dispatch)."""
         return np.asarray(self._lat_hist)
 
+    def resident_telemetry(self) -> np.ndarray:
+        """The paxray post-window telemetry readback: written rows
+        sorted by round ([n, N_TEL_FIELDS] numpy,
+        obs/recorder.py layout). A post-window read by the same
+        discipline as ``end_resident`` — NEVER call it between
+        measured dispatches (paxlint's resident-loop pass flags any
+        call site reachable from a marked dispatch root). Call before
+        ``end_resident`` (which disarms the ring)."""
+        return telemetry_valid_rows(np.asarray(self._telemetry))
+
     def end_resident(self):
         """The once-after-the-measured-window full readback: returns
         the latency histogram (numpy [LATENCY_BINS], exact integer
-        round latencies) and disarms the resident bookkeeping."""
+        round latencies) and disarms the resident bookkeeping
+        (telemetry included — read ``resident_telemetry`` first)."""
         hist = np.asarray(self._lat_hist)
         self._inject_round = None
         self._lat_hist = None
+        self._telemetry = None
         return hist
 
     def kill(self, replica: int) -> None:

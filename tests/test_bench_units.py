@@ -77,6 +77,45 @@ def test_latency_from_hist_empty_and_overflow():
     assert p50 == 4.0  # clipped AT the last bin, never dropped
 
 
+def test_trend_reads_committed_artifacts():
+    """tools/trend.py (report-only): the cross-PR trajectory view
+    parses every committed BENCH_r*.json driver capture — including
+    the crashed (r01) and truncated-replay (r05) ones, which must
+    surface as labeled rows, never silent skips — and renders a
+    markdown table."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    out = subprocess.run(
+        [_sys.executable, str(repo / "tools/trend.py"), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    rows = _json.loads(out.stdout)["bench"]
+    names = {r["artifact"] for r in rows}
+    committed = {p.name for p in repo.glob("BENCH_r*.json")}
+    assert committed <= names  # nothing silently skipped
+    assert all("provenance" in r for r in rows)
+    out = subprocess.run(
+        [_sys.executable, str(repo / "tools/trend.py")],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    assert "| artifact |" in out.stdout
+
+
+def test_overflow_warning_is_loud_and_parser_safe():
+    """A saturated histogram must warn on STDOUT (the artifact stamp
+    alone was missable) without corrupting the one-JSON-line contract:
+    the line cannot start with '{' (salvage_partial / the ladder
+    driver filter on that) and must name the count."""
+    assert bench.overflow_warning(0) is None
+    w = bench.overflow_warning(37)
+    assert w.startswith("WARNING") and not w.startswith("{")
+    assert "latency_hist_overflow=37" in w and "SATURATED" in w
+
+
 def test_latency_hist_agrees_with_latency_rounds():
     """The two latency paths are the same estimator: build a cursor
     history, compute host-side percentiles, then bin the same per-slot

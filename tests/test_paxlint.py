@@ -941,6 +941,36 @@ class Cluster:
     assert "run_resident" in vs[0].msg  # names the responsible root
 
 
+def test_resident_loop_flags_mid_window_telemetry_readback():
+    """The paxray discipline (ISSUE 9): the telemetry ring's readback
+    (np.asarray of the device buffer) is post-window host code — a
+    call of it FROM the marked dispatch root ("just peeking" at the
+    ring between measured dispatches) must be flagged through the
+    self-method edge; the unmarked post-window reader alone is
+    clean."""
+    peeking = '''
+import numpy as np
+
+class Cluster:
+    # paxlint: resident-loop
+    def run_resident(self, k):
+        rows = self.resident_telemetry()   # mid-window peek: a sync
+        return rows
+
+    def resident_telemetry(self):
+        return np.asarray(self._telemetry)
+'''
+    vs = lint_src("minpaxos_tpu/parallel/peek.py", peeking,
+                  "resident-loop")
+    assert len(vs) == 1 and "np.asarray" in vs[0].msg, vs
+    assert "run_resident" in vs[0].msg  # names the responsible root
+    disciplined = peeking.replace(
+        "        rows = self.resident_telemetry()   # mid-window peek: a sync\n"
+        "        return rows", "        return 0")
+    assert lint_src("minpaxos_tpu/parallel/peek.py", disciplined,
+                    "resident-loop") == []
+
+
 def test_resident_loop_real_suppression_is_load_bearing():
     """The ONE sanctioned per-dispatch scalar readback in the real
     tree (ShardedCluster.run_resident) is actually guarded: stripping

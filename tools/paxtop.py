@@ -6,7 +6,10 @@ Polls every replica THROUGH the master's ``stats`` fan-out verb
 behind the cluster tip, commit throughput (delta of the ``committed``
 gauge between polls), the dispatch-regime mix (full / fused / narrow /
 idle-skip — PR 1's multi-modal tick cost, finally visible), exec
-backlog, and p50/p99 tick wall from the typed histogram.
+backlog, paxchaos injected-fault totals and narrow-anchor fallbacks
+(a running chaos campaign or a flapping narrow view is visible
+without a trace dump), and p50/p99 tick wall from the typed
+histogram.
 
     python tools/paxtop.py -mport 7087              # live, 1s refresh
     python tools/paxtop.py -mport 7087 -i 0.5       # faster refresh
@@ -67,6 +70,12 @@ def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
         row["ticks"] = counters.get("ticks", 0)
         row["idle_skips"] = counters.get("idle_skips", 0)
         row["committed"] = counters.get("committed", 0)
+        # live-visible health signals that previously needed a trace
+        # dump: a running chaos campaign (paxchaos injected-fault
+        # total) and a flapping narrow anchor (validation failures
+        # forcing full-width recounts) both show in the table
+        row["chaos_injected"] = counters.get("chaos_injected", 0)
+        row["narrow_fallbacks"] = counters.get("narrow_fallbacks", 0)
         scal = r.get("scalars") or {}
         row["exec_backlog"] = (row["frontier"] + 1
                                - (scal.get("executed", row["frontier"]) + 1))
@@ -97,8 +106,8 @@ def _render(resp: dict, rows: list[dict], clear: bool) -> None:
                f"{time.strftime('%H:%M:%S')}")
     hdr = (f"{'ID':>2} {'ROLE':<8} {'ST':<2} {'FRONTIER':>9} {'LAG':>6} "
            f"{'COMMIT/S':>9} {'BACKLOG':>8} {'DISP':>8} {'FULL%':>6} "
-           f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'p50ms':>7} "
-           f"{'p99ms':>8}")
+           f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'CHAOS':>7} "
+           f"{'NARRFB':>6} {'p50ms':>7} {'p99ms':>8}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for r in rows:
@@ -114,7 +123,8 @@ def _render(resp: dict, rows: list[dict], clear: bool) -> None:
             f"{r['lag']:>6} {ops:>9} {r['exec_backlog']:>8} "
             f"{r['dispatches']:>8} {mix.get('full', 0):>6.1f} "
             f"{mix.get('fused', 0):>6.1f} {mix.get('narrow', 0):>6.1f} "
-            f"{r['idle_skips']:>8} {r['tick_p50_ms']:>7.2f} "
+            f"{r['idle_skips']:>8} {r['chaos_injected']:>7} "
+            f"{r['narrow_fallbacks']:>6} {r['tick_p50_ms']:>7.2f} "
             f"{r['tick_p99_ms']:>8.2f}")
     print("\n".join(out), flush=True)
 

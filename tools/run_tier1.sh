@@ -48,7 +48,16 @@ env JAX_PLATFORMS=cpu python tools/mc.py --smoke || exit 1
 echo "== shape-ladder smoke (2-point resident-loop sweep, drain-exact) =="
 env JAX_PLATFORMS=cpu python tools/shape_ladder.py --smoke || exit 1
 
-# paxchaos smoke fifth: two fixed-seed fault schedules (partition-heal
+# paxray smoke fifth: the resident-telemetry observability contract
+# (ISSUE 9) — telemetry-on vs telemetry-off dispatch wall within 2%
+# (min-of-N, order-alternating A/B), byte-identical protocol state,
+# and a validated merged host+device Chrome trace with the device
+# rounds under the reserved pid. JAX is warm from the ladder smoke;
+# ~45 s including the two dispatch-variant compiles.
+echo "== paxray smoke (telemetry overhead <=2% + merged device trace) =="
+env JAX_PLATFORMS=cpu python tools/obs_smoke.py --resident || exit 1
+
+# paxchaos smoke sixth: two fixed-seed fault schedules (partition-heal
 # + 10% loss/reorder) against a real in-process cluster, checked with
 # the SAME invariant predicates the model checker just proved at small
 # bounds (ROBUSTNESS.md). Budget clock starts after the first run so

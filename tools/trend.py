@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""trend — the cross-PR throughput/latency trajectory, as markdown.
+
+The bench artifacts were stamped for exactly this (`measured_this_run`,
+`resident`, `shape`, mtimes), but nothing ever read them side by side:
+every session that wanted the regression view re-opened BENCH_*.json by
+hand. This tool prints it once: per committed accelerator artifact
+(`BENCH_r*.json` driver captures, `BENCH_LADDER_CPU.json`,
+`BENCH_TCP.json`) the headline throughput, quorum p50/p99, platform and
+shape — plus the repo-growth trajectory from `PROGRESS.jsonl` (per
+driver round: commits, LoC). Report-only: reads the committed
+artifacts, writes nothing, imports no JAX — safe to run anywhere,
+cheap enough to paste into a PR description.
+
+    python tools/trend.py              # markdown tables on stdout
+    python tools/trend.py --json      # machine form
+
+Driver captures (`BENCH_r*.json`) are best-effort parses: some rounds
+crashed mid-write (r01), some hold only a replayed prior record in a
+truncated tail (r05) — rows from a replay are labeled `replay`, rows
+with no parseable record report their error instead of a number, and
+nothing is ever silently skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _balanced_json(text: str, start: int) -> dict | None:
+    """Parse the {...} object starting at ``start`` by brace matching
+    (tolerates trailing garbage; returns None on truncation)."""
+    depth = 0
+    in_str = esc = False
+    for i in range(start, len(text)):
+        c = text[i]
+        if esc:
+            esc = False
+        elif c == "\\":
+            esc = True
+        elif c == '"':
+            in_str = not in_str
+        elif not in_str:
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        return json.loads(text[start:i + 1])
+                    except json.JSONDecodeError:
+                        return None
+    return None
+
+
+def _extract_record(cap: dict) -> tuple[dict | None, str]:
+    """(bench record, provenance) from one BENCH_r*.json driver
+    capture: the `parsed` record when the driver got one, else the
+    last parseable JSON line of the captured tail, else an embedded
+    `"record":` replay inside a truncated tail (labeled as such)."""
+    rec = cap.get("parsed")
+    if isinstance(rec, dict) and "value" in rec:
+        return rec, "live"
+    tail = cap.get("tail") or ""
+    for ln in reversed([l for l in tail.splitlines()
+                        if l.strip().startswith("{")]):
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if "value" in rec:
+            return rec, "live"
+    i = tail.find('"record":')
+    if i >= 0:
+        j = tail.find("{", i)
+        rec = _balanced_json(tail, j) if j >= 0 else None
+        if isinstance(rec, dict) and "value" in rec:
+            return rec, "replay"
+    return None, "unparseable"
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    return f"{v:,}" if isinstance(v, int) else str(v)
+
+
+def _row_from_record(name: str, rec: dict, provenance: str,
+                     mtime: float) -> dict:
+    # a record whose own headline is the error stanza may still carry
+    # a replayed prior value at top level (bench.py replay_marks)
+    value = rec.get("value")
+    if rec.get("error") and not value and rec.get("replayed_value"):
+        value, provenance = rec["replayed_value"], "replay"
+    shape = rec.get("shape") or {}
+    return {
+        "artifact": name,
+        "provenance": provenance,
+        "platform": rec.get("platform"),
+        "resident": rec.get("resident", False),
+        "inst_per_sec": value,
+        "p50_ms": rec.get("p50_quorum_decision_ms",
+                          rec.get("p50_quorum_decision_ms_censored")),
+        "p99_ms": rec.get("p99_quorum_decision_ms"),
+        "concurrent": rec.get("concurrent_instances"),
+        "shape": (f"g={shape.get('n_shards')} w={shape.get('window')} "
+                  f"p={shape.get('proposals')} "
+                  f"k={shape.get('rounds_per_dispatch')}"
+                  if shape else "-"),
+        "error": (rec.get("error") or "")[:60] or None,
+        "mtime_utc": time.strftime("%Y-%m-%d", time.gmtime(mtime)),
+    }
+
+
+def collect_bench_rows(repo: Path = REPO) -> list[dict]:
+    rows = []
+    for path in sorted(glob.glob(str(repo / "BENCH_r*.json"))):
+        name = os.path.basename(path)
+        try:
+            cap = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"artifact": name, "provenance": "unreadable",
+                         "error": repr(e)[:60]})
+            continue
+        rec, prov = _extract_record(cap)
+        if rec is None:
+            rows.append({"artifact": name, "provenance": prov,
+                         "error": f"rc={cap.get('rc')}, no record in tail"})
+            continue
+        rows.append(_row_from_record(name, rec, prov,
+                                     os.path.getmtime(path)))
+    lad = repo / "BENCH_LADDER_CPU.json"
+    if lad.exists():
+        try:
+            rec = json.load(open(lad))
+            rows.append(_row_from_record(lad.name, rec, "live",
+                                         os.path.getmtime(lad)))
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"artifact": lad.name, "provenance": "unreadable",
+                         "error": repr(e)[:60]})
+    return rows
+
+
+def collect_tcp_row(repo: Path = REPO) -> dict | None:
+    path = repo / "BENCH_TCP.json"
+    if not path.exists():
+        return None
+    try:
+        rec = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {
+        "artifact": path.name,
+        "ops_per_sec": rec.get("ops_per_sec"),
+        "serial_p50_ms": rec.get("serial_p50_ms"),
+        "serial_p99_ms": rec.get("serial_p99_ms"),
+        "mtime_utc": time.strftime(
+            "%Y-%m-%d", time.gmtime(os.path.getmtime(path))),
+    }
+
+
+def collect_progress(repo: Path = REPO) -> list[dict]:
+    """Last PROGRESS.jsonl sample per driver round: commits and LoC at
+    round end — the repo-growth axis the bench trajectory rides on."""
+    path = repo / "PROGRESS.jsonl"
+    if not path.exists():
+        return []
+    last: dict[int, dict] = {}
+    for ln in path.read_text().splitlines():
+        try:
+            d = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if "round" in d:
+            last[int(d["round"])] = d
+    return [
+        {"round": r, "commits": d.get("commits"), "loc": d.get("loc"),
+         "wall_h": round((d.get("wall_s") or 0) / 3600.0, 1)}
+        for r, d in sorted(last.items())
+    ]
+
+
+def render_markdown(bench, tcp, progress) -> str:
+    out = ["## Cross-PR bench trajectory (device loop)", ""]
+    hdr = ("| artifact | when | platform | resident | inst/s | p50 ms "
+           "| p99 ms | concurrent | shape | note |")
+    out += [hdr, "|" + "---|" * 10]
+    for r in bench:
+        note = r.get("error") or (
+            "replay" if r.get("provenance") == "replay" else "")
+        out.append(
+            f"| {r['artifact']} | {r.get('mtime_utc', '-')} "
+            f"| {r.get('platform', '-')} "
+            f"| {'y' if r.get('resident') else 'n'} "
+            f"| {_fmt(r.get('inst_per_sec'))} | {_fmt(r.get('p50_ms'), 2)} "
+            f"| {_fmt(r.get('p99_ms'), 2)} | {_fmt(r.get('concurrent'))} "
+            f"| {r.get('shape', '-')} | {note} |")
+    if tcp:
+        out += ["", "## TCP runtime (BENCH_TCP.json)", "",
+                "| artifact | when | ops/s | serial p50 ms | serial p99 ms |",
+                "|" + "---|" * 5,
+                f"| {tcp['artifact']} | {tcp['mtime_utc']} "
+                f"| {_fmt(tcp['ops_per_sec'])} "
+                f"| {_fmt(tcp['serial_p50_ms'], 2)} "
+                f"| {_fmt(tcp['serial_p99_ms'], 2)} |"]
+    if progress:
+        out += ["", "## Repo growth (PROGRESS.jsonl, per driver round)", "",
+                "| round | commits | LoC | wall h |", "|" + "---|" * 4]
+        out += [f"| {p['round']} | {_fmt(p['commits'])} | {_fmt(p['loc'])} "
+                f"| {p['wall_h']} |" for p in progress]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "trend", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the collected rows as JSON instead of "
+                         "markdown")
+    ap.add_argument("--repo", default=str(REPO),
+                    help="repo root holding the artifacts")
+    args = ap.parse_args(argv)
+    repo = Path(args.repo)
+    bench = collect_bench_rows(repo)
+    tcp = collect_tcp_row(repo)
+    progress = collect_progress(repo)
+    if args.json:
+        print(json.dumps({"bench": bench, "tcp": tcp,
+                          "progress": progress}, indent=1))
+    else:
+        print(render_markdown(bench, tcp, progress))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
