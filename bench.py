@@ -449,10 +449,20 @@ def measure(shape: tuple[int, int, int, int] | None = None,
     # ladder's bigger rungs) cu drops to economy sizing instead.
     do_fault = os.environ.get("MP_BENCH_FAULT", "1") != "0"
     cu_rows = 512 if on_tpu else cpu_catchup_rows(p, do_fault)
+    # occupancy-adaptive capacity (PR 11): a --ladder winner may carry
+    # an inbox capacity derived from its measured delivered-occupancy
+    # high-water mark (paxray TEL_INBOX_HWM), with the kernel inbox
+    # compacted to the same rows (cfg.compact_inbox) — threaded to
+    # this child via env exactly like the shape, so the measured
+    # record runs the capacity that won the sweep
+    inbox_rows = int(os.environ.get("MP_BENCH_INBOX", "0") or 0) \
+        or (p + 2 * cu_rows + 64 + 64)
+    compact_rows = int(os.environ.get("MP_BENCH_COMPACT", "0") or 0)
     cfg = MinPaxosConfig(
-        n_replicas=5, window=w, inbox=p + 2 * cu_rows + 64 + 64,
+        n_replicas=5, window=w, inbox=inbox_rows,
         exec_batch=p, kv_pow2=15 if on_tpu else cpu_kv_pow2(p),
-        catchup_rows=cu_rows, recovery_rows=64)
+        catchup_rows=cu_rows, recovery_rows=64,
+        compact_inbox=compact_rows)
     t_boot = time.perf_counter()
     try:
         # key_space < KV capacity: the run inserts ~dispatches*k*p
@@ -810,6 +820,9 @@ def measure(shape: tuple[int, int, int, int] | None = None,
                          "seed": WORKLOAD_SEED},
             "shape": {"n_shards": g, "window": w, "proposals": p,
                       "rounds_per_dispatch": k, "catchup_rows": cu_rows,
+                      "inbox": cfg.inbox,
+                      "compact_inbox": cfg.compact_inbox,
+                      "route_fabric": cfg.route_fabric,
                       "shard_devices": shard_devices,
                       "ladder_chosen": ladder is not None},
             "proposals_per_round": g * p,
@@ -982,6 +995,11 @@ def _run_ladder_mode() -> None:
                     MP_BENCH_CPU_OK="1",
                     MP_BENCH_LADDER_FILE=sweep_path,
                     MP_BENCH_SHARD_DEVICES=str(win["shard_devices"]),
+                    # occupancy-adaptive capacity rides along: the
+                    # measured record must run the winner's inbox /
+                    # compaction, not re-derive the default sizing
+                    MP_BENCH_INBOX=str(win.get("inbox") or 0),
+                    MP_BENCH_COMPACT=str(win.get("compact_inbox") or 0),
                     # throughput shapes use economy catch-up sizing;
                     # kill/recover stays with the default-shape run
                     # (same policy as the TPU ladder's bigger rungs)

@@ -7,8 +7,11 @@ the reference runs N processes exchanging TCP messages
 leading array axis, runs the identical per-replica protocol step under
 ``vmap``, and *routes messages as array ops*: each replica's outbox rows
 carry a ``dst``; routing pools all outboxes and compacts each replica's
-addressed rows into its next inbox with a cumsum-scatter (stable, no
-sort). Replica failure is a mask (see ``alive``): a dead replica's rows
+addressed rows into its next inbox in ONE segmented pass (a single
+segment-prefix-sum over the pooled rows + a scatter-free searchsorted
+winner — ops/segscatter.py; the original per-destination cumsum-scatter
+fabric survives behind ``route_fabric="dense"`` for the byte-equality
+pin). Replica failure is a mask (see ``alive``): a dead replica's rows
 are dropped and its inbox zeroed — the programmatic version of the
 reference's kill/revive scripts.
 
@@ -41,6 +44,7 @@ from minpaxos_tpu.models.minpaxos import (
     replica_step_impl,
 )
 from minpaxos_tpu.ops.packed import join_i64, split_i64
+from minpaxos_tpu.ops.segscatter import gather_rows, prefix_pack_plan, route_plan
 from minpaxos_tpu.ops.winner import gather_row, slot_winner
 from minpaxos_tpu.wire.messages import MsgKind, Op
 
@@ -65,12 +69,20 @@ def tree_set(tree, i, sub):
 
 def _route(cfg: MinPaxosConfig, out_msgs: MsgBatch, dst: jnp.ndarray,
            alive: jnp.ndarray, capacity: int) -> MsgBatch:
-    """Pool all replicas' outboxes and build each replica's next inbox.
+    """The ORIGINAL dense routing fabric (``route_fabric="dense"``):
+    pool all replicas' outboxes and build each replica's next inbox
+    with a masked cumsum + scatter per destination.
 
     dst semantics: -1 broadcast to all *other* replicas, >=0 unicast,
     -2 client-bound (excluded here; the host collects those).
     Overflow beyond ``capacity`` rows is dropped — legal under Paxos
     (message loss), sized to be impossible in steady state.
+
+    Kept for the byte-equality pin of the segmented fabric
+    (tests/test_route_fabric.py) and the profile_substeps before/after
+    table; O(R²·M) scans plus a per-destination scatter that
+    serializes on XLA:CPU — ``_route_segmented`` replaces it on the
+    hot path (PR 11).
     """
     r = cfg.n_replicas
     flat = jax.tree_util.tree_map(lambda x: x.reshape(-1), out_msgs)  # [R*M]
@@ -95,6 +107,58 @@ def _route(cfg: MinPaxosConfig, out_msgs: MsgBatch, dst: jnp.ndarray,
     return jax.vmap(inbox_for)(jnp.arange(r))
 
 
+def _route_segmented(cfg: MinPaxosConfig, out_msgs: MsgBatch,
+                     dst: jnp.ndarray, alive: jnp.ndarray,
+                     capacity: int) -> MsgBatch:
+    """One-pass segmented routing fabric (``route_fabric="segmented"``,
+    the default): each pooled outbox row's destination segment is
+    computed once, ONE segment-prefix-sum yields per-destination
+    offsets (broadcast rows expand in index arithmetic only — the
+    payload pool is never copied per destination), and the winner per
+    inbox slot is recovered scatter-free via searchsorted
+    (ops/segscatter.py rationale). Byte-identical to ``_route``
+    including row order and overflow-drop semantics — pinned by
+    tests/test_route_fabric.py and the golden kernel fixtures."""
+    r = cfg.n_replicas
+    m = out_msgs.kind.shape[1]
+    flat = jax.tree_util.tree_map(lambda x: x.reshape(-1), out_msgs)  # [R*M]
+    src_rep = jnp.repeat(jnp.arange(r, dtype=jnp.int32), m)
+    win, hit = route_plan(flat.kind, src_rep, dst.reshape(-1), alive,
+                          capacity)
+    return gather_rows(flat, win, hit)
+
+
+def _deliver_inbox(cfg: MinPaxosConfig, pending: MsgBatch, ext: MsgBatch,
+                   alive: jnp.ndarray) -> MsgBatch:
+    """Merge routed pending rows + host-injected ext rows into the
+    inbox the protocol kernel consumes; dead replicas see silence.
+
+    With ``cfg.compact_inbox`` > 0 the merged rows are COMPACTED: live
+    rows pack to a prefix (order preserved) of a ``compact_inbox``-row
+    buffer, so every [M]-shaped kernel computation runs at that
+    smaller static shape instead of inbox+ext_rows. Rows beyond the
+    compacted capacity drop (legal message loss) — capacity is sized
+    from the measured occupancy high-water mark (paxray
+    TEL_INBOX_HWM), and the shape ladder only crowns lossless points.
+    Compaction preserves the commit stream byte-for-byte (delivery
+    content/order are unchanged; only padding gaps vanish) but may
+    merge ack runs across removed gaps — protocol-equivalent, pinned
+    by tests/test_route_fabric.py."""
+    inbox = _concat_rows(pending, ext)
+    inbox = inbox._replace(
+        kind=jnp.where(alive[:, None], inbox.kind, 0))
+    cap = cfg.compact_inbox
+    if cap and inbox.kind.shape[-1] > cap:
+        live = inbox.kind != 0
+        win, hit = jax.vmap(
+            functools.partial(prefix_pack_plan, capacity=cap))(live)
+        winc = jnp.where(hit, win, 0)
+        inbox = jax.tree_util.tree_map(
+            lambda col: jnp.where(
+                hit, jnp.take_along_axis(col, winc, axis=-1), 0), inbox)
+    return inbox
+
+
 def cluster_step_impl(
     cfg: MinPaxosConfig, cs: ClusterState, ext: MsgBatch,
     step_impl=replica_step_impl,
@@ -116,13 +180,11 @@ def cluster_step_impl(
     # strip the gate at this choke point so callers don't each have to
     # remember to pass gate_exec=False
     cfg = cfg._replace(gate_exec=False)
-    inbox = _concat_rows(cs.pending, ext)
-    # dead replicas see silence
-    inbox = inbox._replace(
-        kind=jnp.where(cs.alive[:, None], inbox.kind, 0))
+    inbox = _deliver_inbox(cfg, cs.pending, ext, cs.alive)
     states, outbox, execr = jax.vmap(
         functools.partial(step_impl, cfg))(cs.states, inbox)
-    pending = _route(cfg, outbox.msgs, outbox.dst, cs.alive, cfg.inbox)
+    route = _route if cfg.route_fabric == "dense" else _route_segmented
+    pending = route(cfg, outbox.msgs, outbox.dst, cs.alive, cfg.inbox)
     client_rows = outbox.msgs
     client_mask = (outbox.dst == -2) & (outbox.msgs.kind != 0)
     return ClusterState(states, pending, cs.alive), execr, client_rows, client_mask

@@ -180,14 +180,26 @@ def mencius_step_impl(
     dst = jnp.full(M, -1, jnp.int32)
 
     # ---- 1. PROPOSE into my owned slots (handlePropose :429-447) ----
-    prefix = jnp.cumsum(is_propose.astype(jnp.int32)) - 1
+    csum_p = jnp.cumsum(is_propose.astype(jnp.int32))
+    prefix = csum_p - 1
     slots_p = state.crt_own + R * prefix
     rel_p = slots_p - state.window_base
     fits = is_propose & (rel_p >= 0) & (rel_p < S)
     me_bit = (jnp.int32(1) << me).astype(jnp.uint16)
     # one winning row per slot + dense gathers instead of per-column
-    # scatters (ops/winner.py; targets unique by the cumsum)
-    win_p, hit_p = slot_winner(S, rel_p, fits)
+    # scatters (ops/winner.py rationale) — and the winner itself is
+    # recovered WITHOUT a scatter (PR 11): propose targets stride R
+    # from crt_own, so window slot s takes propose rank
+    # q = (abs - crt_own) / R, and rank q's row is a searchsorted
+    # probe into the propose prefix count (scatters serialize on
+    # XLA:CPU — ops/segscatter.py rationale)
+    off_p = idx_abs - state.crt_own
+    rank_p = off_p // R
+    hit_p = ((off_p >= 0) & (jnp.mod(off_p, R) == 0)
+             & (rank_p < csum_p[-1]))
+    win_p = jnp.searchsorted(
+        csum_p, jnp.clip(rank_p, 0, M - 1) + 1).astype(jnp.int32)
+    win_p = jnp.where(hit_p, win_p, -1)
     state = state._replace(
         ballot=gather_const(hit_p, 0, state.ballot),
         status=gather_const(hit_p, ACCEPTED, state.status),

@@ -45,7 +45,6 @@ from minpaxos_tpu.ops.ackruns import (
 )
 from minpaxos_tpu.ops.kvstore import KVState, kv_apply_batch, kv_init
 from minpaxos_tpu.ops.scan import commit_frontier
-from minpaxos_tpu.ops.winner import gather_const, gather_row, slot_winner
 from minpaxos_tpu.wire.messages import MsgKind
 
 # Log-slot statuses (reference minpaxosproto.go:8-15 plus EXECUTED,
@@ -105,6 +104,24 @@ class MinPaxosConfig(NamedTuple):
     # ~4 extra process wakeups that serialized into commit latency on
     # small hosts (round-5 trace; cli/server.py -gossipticks).
     gossip_ticks: int = 1
+    # Routing-fabric selector (static): "segmented" = the one-pass
+    # segmented scatter (ops/segscatter.py — one segment-prefix-sum
+    # over the pooled outbox rows, winner via searchsorted, 12 dense
+    # gathers; PR 11); "dense" = the original per-destination
+    # vmap-over-R masked cumsum (kept for the byte-equality pin and
+    # the profile_substeps before/after table). Both produce
+    # byte-identical inboxes (tests/test_route_fabric.py); segmented
+    # measures 2.5-3.5x faster at bench capacities on the CPU host.
+    route_fabric: str = "segmented"
+    # Inbox compaction (static, 0 = off): deliver the merged
+    # pending+ext inbox COMPACTED to this many rows — live rows pack to
+    # a prefix (order preserved, ops/segscatter.py prefix_pack_plan)
+    # and every [M]-shaped kernel computation runs at this smaller
+    # static shape instead of inbox+ext_rows. Overflow beyond the
+    # compacted capacity drops (legal message loss) — size it from the
+    # measured occupancy high-water mark (paxray TEL_INBOX_HWM; the
+    # shape ladder sweeps this axis and requires lossless points).
+    compact_inbox: int = 0
     # Protocol selector: False = MinPaxos (global ballot, commits learned
     # from the LastCommitted piggyback on Accepts — bareminpaxos.go hot
     # path, SURVEY.md 3.2); True = classic per-instance Multi-Paxos
@@ -400,49 +417,48 @@ def replica_step_impl(
     #   (handlePrepareReply's log-suffix merge, bareminpaxos.go:934-947,
     #   and classic paxos.go:577-612 semantics);
     # * pvotes — EVERY current-ballot answer (value or "empty") counts
-    #   toward the majority that gates no-op gap fill (7d). ----
+    #   toward the majority that gates no-op gap fill (7d).
+    # PR 11: the PIR and ACCEPT sections' slot WRITES are fused into
+    # one keyed winner pass (write A below) — the predicates here stay
+    # verbatim, and the ACCEPT section reads PIR's would-be writes
+    # through closed forms (ballot1) instead of a materialized store,
+    # so the fused kernel is byte-identical to the sequential one
+    # (golden fixtures pin it). ----
     is_pir = k == int(MsgKind.PREPARE_INST_REPLY)
     # packed-bitmask identities for this replica / per-row senders
     me_bit = (jnp.int32(1) << state.me).astype(jnp.uint16)
     src_bit = (jnp.int32(1) << jnp.clip(inbox.src, 0, R - 1)).astype(
         jnp.uint16)
-    rel_v, in_win_v = _rel(state, inbox.inst, S)
-    rel_v_safe = jnp.minimum(rel_v, S - 1)
+    rows_m = jnp.arange(M, dtype=jnp.int32)
+    # every inst-addressed section (1c/2/2b/3) shares one window
+    # translation of inbox.inst — computed once
+    rel_i, in_win_i = _rel(state, inbox.inst, S)
+    rel_i_safe = jnp.minimum(rel_i, S - 1)
     pv_ok = (
         is_pir
         & state.is_leader
         & (inbox.last_committed == state.default_ballot)  # context tag
-        & in_win_v
+        & in_win_i
     )
     state = state._replace(
-        pvotes=state.pvotes | scatter_vote_bits(S, rel_v, inbox.src,
+        pvotes=state.pvotes | scatter_vote_bits(S, rel_i, inbox.src,
                                                 pv_ok, R))
     pir_ok = (
         pv_ok
-        & (state.status[rel_v_safe] < COMMITTED)
-        & (inbox.ballot > state.ballot[rel_v_safe])
+        & (state.status[rel_i_safe] < COMMITTED)
+        & (inbox.ballot > state.ballot[rel_i_safe])
     )
     # max-vballot wins per slot within the batch
     vb_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
-        jnp.where(pir_ok, rel_v, S)].max(inbox.ballot, mode="drop")
-    pir_win = pir_ok & (inbox.ballot == vb_max[rel_v_safe])
-    # one winning row per slot, then dense gathers (ops/winner.py: ten
-    # per-column scatters serialize on TPU; this is one scatter total)
-    win_v, hit_v = slot_winner(S, rel_v, pir_win)
-    state = state._replace(
-        ballot=gather_row(win_v, hit_v, inbox.ballot, state.ballot),
-        status=gather_const(hit_v, ACCEPTED, state.status),
-        op=gather_row(win_v, hit_v, inbox.op, state.op),
-        key_hi=gather_row(win_v, hit_v, inbox.key_hi, state.key_hi),
-        key_lo=gather_row(win_v, hit_v, inbox.key_lo, state.key_lo),
-        val_hi=gather_row(win_v, hit_v, inbox.val_hi, state.val_hi),
-        val_lo=gather_row(win_v, hit_v, inbox.val_lo, state.val_lo),
-        cmd_id=gather_row(win_v, hit_v, inbox.cmd_id, state.cmd_id),
-        client_id=gather_row(win_v, hit_v, inbox.client_id, state.client_id),
-        votes=gather_const(hit_v, me_bit, state.votes),
-        crt_inst=jnp.maximum(
-            state.crt_inst, jnp.max(jnp.where(pir_ok, inbox.inst, -1)) + 1),
-    )
+        jnp.where(pir_ok, rel_i, S)].max(inbox.ballot, mode="drop")
+    pir_win = pir_ok & (inbox.ballot == vb_max[rel_i_safe])
+    # PIR's would-be ballot write as a closed form: a hit slot's new
+    # ballot IS vb_max (pir_win requires equality), and pir_ok requires
+    # inbox.ballot > state.ballot[rel] >= NO_BALLOT, so vb_max >
+    # NO_BALLOT detects hits exactly — no winner scatter needed for
+    # the view the ACCEPT predicates read
+    hit_v = vb_max[:S] > NO_BALLOT
+    ballot1 = jnp.where(hit_v, vb_max[:S], state.ballot)
 
     # ---- 2. ACCEPT (handleAccept :753-806) ----
     # Seeing a higher ballot in an ACCEPT also deposes us: a leader
@@ -456,43 +472,57 @@ def replica_step_impl(
         leader_id=jnp.where(deposed, acc_max_src, state.leader_id),
         prepared=jnp.where(deposed, False, state.prepared),
     )
-    rel_a, in_win = _rel(state, inbox.inst, S)
-    rel_a_safe = jnp.minimum(rel_a, S - 1)
     acc_pre = (
         is_accept
-        & in_win
+        & in_win_i
         & (inbox.ballot >= state.default_ballot)
-        & (inbox.ballot >= state.ballot[rel_a_safe])
-        & (state.status[rel_a_safe] < COMMITTED)
+        & (inbox.ballot >= ballot1[rel_i_safe])  # post-PIR ballot view
+        & (state.status[rel_i_safe] < COMMITTED)
     )
     # duplicate rows for one slot (old + new leader in one pooled
     # inbox): only the max-ballot row may write, or per-field scatter
     # could tear the slot (ballot from one row, value from another)
     ab_max = jnp.full(S + 1, NO_BALLOT, jnp.int32).at[
-        jnp.where(acc_pre, rel_a, S)].max(inbox.ballot, mode="drop")
-    acc_ok = acc_pre & (inbox.ballot == ab_max[rel_a_safe])
-    win_a, hit_a = slot_winner(S, rel_a, acc_ok)
+        jnp.where(acc_pre, rel_i, S)].max(inbox.ballot, mode="drop")
+    acc_ok = acc_pre & (inbox.ballot == ab_max[rel_i_safe])
+
+    # ---- fused slot write A (PIR + ACCEPT) ----
+    # One keyed winner scatter replaces the two sections' slot_winner
+    # passes and 2x9 column writes: key = section*M + row, so an
+    # ACCEPT row beats any PIR row on its slot (the sequential code's
+    # overwrite order) and the max row index wins within a section
+    # (slot_winner's tie-break). Each inbox row belongs to at most one
+    # section (kind-exclusive), so the key decodes unambiguously.
+    okA = pir_win | acc_ok
+    keyA = jnp.full(S + 1, -1, jnp.int32).at[
+        jnp.where(okA, rel_i, S)].max(
+        jnp.where(acc_ok, M + rows_m, rows_m), mode="drop")[:S]
+    hitA = keyA >= 0
+    secA_acc = keyA >= M  # winner came from the ACCEPT section
+    rowA = jnp.mod(keyA, M)  # valid index even for keyA == -1 (masked)
     state = state._replace(
-        ballot=gather_row(win_a, hit_a, inbox.ballot, state.ballot),
-        status=gather_const(hit_a, ACCEPTED, state.status),
-        op=gather_row(win_a, hit_a, inbox.op, state.op),
-        key_hi=gather_row(win_a, hit_a, inbox.key_hi, state.key_hi),
-        key_lo=gather_row(win_a, hit_a, inbox.key_lo, state.key_lo),
-        val_hi=gather_row(win_a, hit_a, inbox.val_hi, state.val_hi),
-        val_lo=gather_row(win_a, hit_a, inbox.val_lo, state.val_lo),
-        cmd_id=gather_row(win_a, hit_a, inbox.cmd_id, state.cmd_id),
-        client_id=gather_row(win_a, hit_a, inbox.client_id, state.client_id),
-        # accepting a newer ballot supersedes any older votes
-        votes=gather_row(win_a, hit_a, src_bit, state.votes),
-        default_ballot=jnp.maximum(state.default_ballot,
-                                   jnp.max(jnp.where(is_accept, inbox.ballot, NO_BALLOT))),
-        max_recv_ballot=jnp.maximum(state.max_recv_ballot,
-                                    jnp.max(jnp.where(is_accept, inbox.ballot, NO_BALLOT))),
+        ballot=jnp.where(hitA, inbox.ballot[rowA], state.ballot),
+        status=jnp.where(hitA, jnp.uint8(ACCEPTED), state.status),
+        op=jnp.where(hitA, inbox.op[rowA].astype(state.op.dtype), state.op),
+        key_hi=jnp.where(hitA, inbox.key_hi[rowA], state.key_hi),
+        key_lo=jnp.where(hitA, inbox.key_lo[rowA], state.key_lo),
+        val_hi=jnp.where(hitA, inbox.val_hi[rowA], state.val_hi),
+        val_lo=jnp.where(hitA, inbox.val_lo[rowA], state.val_lo),
+        cmd_id=jnp.where(hitA, inbox.cmd_id[rowA], state.cmd_id),
+        client_id=jnp.where(hitA, inbox.client_id[rowA], state.client_id),
+        # PIR adoption votes for itself; accepting a newer ballot
+        # supersedes any older votes with the sender's bit
+        votes=jnp.where(hitA, jnp.where(secA_acc, src_bit[rowA], me_bit),
+                        state.votes),
+        default_ballot=jnp.maximum(state.default_ballot, acc_max_ballot),
+        max_recv_ballot=jnp.maximum(state.max_recv_ballot, acc_max_ballot),
         # followers track the log extent so a later election starts
         # assigning after everything they've seen (the reference keeps
         # crtInstance on followers the same way)
         crt_inst=jnp.maximum(
-            state.crt_inst, jnp.max(jnp.where(acc_ok, inbox.inst, -1)) + 1),
+            state.crt_inst,
+            jnp.maximum(jnp.max(jnp.where(pir_ok, inbox.inst, -1)),
+                        jnp.max(jnp.where(acc_ok, inbox.inst, -1))) + 1),
     )
     # A re-ACCEPT of a slot we already hold COMMITTED is acked (not
     # NACKed) iff it carries the identical decided value: commitment is
@@ -501,15 +531,15 @@ def replica_step_impl(
     # needs these votes to reach majority (second half of the
     # elected-laggard livelock fix; value mismatch still NACKs).
     acc_com_match = (
-        is_accept & in_win
-        & (state.status[rel_a_safe] >= COMMITTED)
-        & (state.op[rel_a_safe] == inbox.op)
-        & (state.key_hi[rel_a_safe] == inbox.key_hi)
-        & (state.key_lo[rel_a_safe] == inbox.key_lo)
-        & (state.val_hi[rel_a_safe] == inbox.val_hi)
-        & (state.val_lo[rel_a_safe] == inbox.val_lo)
-        & (state.cmd_id[rel_a_safe] == inbox.cmd_id)
-        & (state.client_id[rel_a_safe] == inbox.client_id)
+        is_accept & in_win_i
+        & (state.status[rel_i_safe] >= COMMITTED)
+        & (state.op[rel_i_safe] == inbox.op)
+        & (state.key_hi[rel_i_safe] == inbox.key_hi)
+        & (state.key_lo[rel_i_safe] == inbox.key_lo)
+        & (state.val_hi[rel_i_safe] == inbox.val_hi)
+        & (state.val_lo[rel_i_safe] == inbox.val_lo)
+        & (state.cmd_id[rel_i_safe] == inbox.cmd_id)
+        & (state.client_id[rel_i_safe] == inbox.client_id)
     )
     # ack every ACCEPT row (ok=0 NACK carries our promised ballot),
     # run-length compressed: one reply row per maximal contiguous
@@ -568,8 +598,8 @@ def replica_step_impl(
     # sweep no-op fill an acked slot). The promise is the global
     # default_ballot, already raised by steps 1-2.
     is_pinst = k == int(MsgKind.PREPARE_INST)
-    rel_pi, in_win_pi = _rel(state, inbox.inst, S)
-    rel_pi_safe = jnp.minimum(rel_pi, S - 1)
+    rel_pi_safe = rel_i_safe  # shared inst->window translation
+    in_win_pi = in_win_i
     pi_answer = is_pinst & (inbox.ballot >= state.default_ballot) & (
         in_win_pi | (inbox.inst >= state.crt_inst))
     # Slots we already hold COMMITTED answer with a COMMIT row instead
@@ -624,20 +654,11 @@ def replica_step_impl(
         com_bal >= state.default_ballot)
     state = state._replace(
         leader_id=jnp.where(adopt_com, com_src, state.leader_id))
-    rel_c, in_win_c = _rel(state, inbox.inst, S)
-    com_ok = is_commit & in_win_c
-    win_c, hit_c = slot_winner(S, rel_c, com_ok)
+    com_ok = is_commit & in_win_i
+    # slot writes DEFERRED into fused write B (after 5 — commit and
+    # propose target provably disjoint slots this batch, see below);
+    # the log-extent update must happen NOW, before 5 assigns slots
     state = state._replace(
-        ballot=gather_row(win_c, hit_c, inbox.ballot, state.ballot),
-        status=jnp.where(hit_c, jnp.maximum(state.status, COMMITTED),
-                         state.status),
-        op=gather_row(win_c, hit_c, inbox.op, state.op),
-        key_hi=gather_row(win_c, hit_c, inbox.key_hi, state.key_hi),
-        key_lo=gather_row(win_c, hit_c, inbox.key_lo, state.key_lo),
-        val_hi=gather_row(win_c, hit_c, inbox.val_hi, state.val_hi),
-        val_lo=gather_row(win_c, hit_c, inbox.val_lo, state.val_lo),
-        cmd_id=gather_row(win_c, hit_c, inbox.cmd_id, state.cmd_id),
-        client_id=gather_row(win_c, hit_c, inbox.client_id, state.client_id),
         crt_inst=jnp.maximum(
             state.crt_inst, jnp.max(jnp.where(com_ok, inbox.inst, -1)) + 1),
     )
@@ -680,18 +701,40 @@ def replica_step_impl(
     slots = state.crt_inst + slot_off
     rel_p = slots - state.window_base
     fits = prop & (rel_p >= 0) & (rel_p < S)
-    win_p, hit_p = slot_winner(S, rel_p, fits)  # targets unique by cumsum
+
+    # ---- fused slot write B (COMMIT + PROPOSE) ----
+    # The two sections' targets are disjoint within one batch: every
+    # com_ok row bumped crt_inst past its inst (section 3, above), and
+    # propose slots start at the post-bump crt_inst — so one keyed
+    # winner pass applies both (key = section*M + row; propose targets
+    # are unique by the cumsum, commit rows tie-break by max row index
+    # exactly as slot_winner did).
+    okB = com_ok | fits
+    keyB = jnp.full(S + 1, -1, jnp.int32).at[
+        jnp.where(okB, jnp.where(fits, rel_p, rel_i), S)].max(
+        jnp.where(fits, M + rows_m, rows_m), mode="drop")[:S]
+    hitB = keyB >= 0
+    secB_prop = keyB >= M  # winner came from the PROPOSE section
+    rowB = jnp.mod(keyB, M)
     state = state._replace(
-        ballot=gather_const(hit_p, state.default_ballot, state.ballot),
-        status=gather_const(hit_p, ACCEPTED, state.status),
-        op=gather_row(win_p, hit_p, inbox.op, state.op),
-        key_hi=gather_row(win_p, hit_p, inbox.key_hi, state.key_hi),
-        key_lo=gather_row(win_p, hit_p, inbox.key_lo, state.key_lo),
-        val_hi=gather_row(win_p, hit_p, inbox.val_hi, state.val_hi),
-        val_lo=gather_row(win_p, hit_p, inbox.val_lo, state.val_lo),
-        cmd_id=gather_row(win_p, hit_p, inbox.cmd_id, state.cmd_id),
-        client_id=gather_row(win_p, hit_p, inbox.client_id, state.client_id),
-        votes=gather_const(hit_p, me_bit, state.votes),
+        # propose stamps the serving ballot; commit keeps the row's
+        ballot=jnp.where(hitB, jnp.where(secB_prop, state.default_ballot,
+                                         inbox.ballot[rowB]), state.ballot),
+        # commit never downgrades (max with COMMITTED); propose accepts
+        status=jnp.where(
+            hitB, jnp.where(secB_prop, jnp.uint8(ACCEPTED),
+                            jnp.maximum(state.status,
+                                        jnp.uint8(COMMITTED))),
+            state.status),
+        op=jnp.where(hitB, inbox.op[rowB].astype(state.op.dtype), state.op),
+        key_hi=jnp.where(hitB, inbox.key_hi[rowB], state.key_hi),
+        key_lo=jnp.where(hitB, inbox.key_lo[rowB], state.key_lo),
+        val_hi=jnp.where(hitB, inbox.val_hi[rowB], state.val_hi),
+        val_lo=jnp.where(hitB, inbox.val_lo[rowB], state.val_lo),
+        cmd_id=jnp.where(hitB, inbox.cmd_id[rowB], state.cmd_id),
+        client_id=jnp.where(hitB, inbox.client_id[rowB], state.client_id),
+        # only propose seeds votes (the leader votes for itself)
+        votes=jnp.where(hitB & secB_prop, me_bit, state.votes),
         crt_inst=state.crt_inst + jnp.where(fits, 1, 0).sum(),
     )
     # broadcast ACCEPT rows for accepted proposals; rejection replies

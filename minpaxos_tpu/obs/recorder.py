@@ -110,13 +110,20 @@ DEVICE_PID = 9999
 #   delta — the per-row cost driver ROADMAP item 1 names);
 # prepared_shards — shards whose cursor replica is a prepared leader
 #   (== n_shards is the steady state; below it, an election/recovery
-#   is in flight).
+#   is in flight);
+# inbox_hwm — the round's max per-(shard, replica) DELIVERED inbox
+#   rows, routed + injected (inbox_rows is the routed cross-cluster
+#   SUM; the per-inbox max is what a single inbox — and a compacted
+#   kernel inbox — must hold). Its high-water mark over a run is the
+#   measured occupancy that feeds adaptive capacity selection: the
+#   shape ladder's inbox axis and the compact_inbox sizing read it
+#   (tools/shape_ladder.py, PR 11).
 (TEL_ROUND, TEL_COMMITTED, TEL_IN_FLIGHT, TEL_ASSIGNED, TEL_INJECTED,
- TEL_INBOX_ROWS, TEL_CLAIM_ROWS, TEL_PREPARED) = range(8)
-N_TEL_FIELDS = 8
+ TEL_INBOX_ROWS, TEL_CLAIM_ROWS, TEL_PREPARED, TEL_INBOX_HWM) = range(9)
+N_TEL_FIELDS = 9
 TEL_FIELD_NAMES = ("round", "committed_delta", "in_flight", "assigned",
                    "injected_rows", "inbox_rows", "claim_rows",
-                   "prepared_shards")
+                   "prepared_shards", "inbox_hwm")
 
 
 def telemetry_valid_rows(buf) -> np.ndarray:

@@ -1,0 +1,112 @@
+"""One-pass segmented routing/compaction plans (shared kernels).
+
+The pod-mode routing fabric compacts every replica's addressed outbox
+rows into per-destination inboxes. The original fabric
+(models/cluster.py ``_route``) vmapped a full masked cumsum + scatter
+over the [R·M] pooled rows once PER DESTINATION — O(R²·M) scans, and
+the per-destination ``slot_winner`` scatter serializes on XLA:CPU
+(measured: the scatter-based variant is not faster than the old fabric
+at all; the scatter IS the cost — tools/scatter_micro.py leg e/f).
+
+The segmented plan here does the whole fan-out in one pass:
+
+* each pooled row's destination SEGMENT is computed once (broadcast /
+  unicast / client-bound / dead-link, pure [N]-sized masks);
+* ONE segment-prefix-sum over the pooled rows (a single cumulative sum
+  with the R destination lanes batched — not R independent scans)
+  yields every row's offset within its destination inbox; broadcast
+  rows expand only in this index arithmetic (dup-free positions, the
+  ops/winner.py trick) — the 12 payload columns are NEVER copied per
+  destination;
+* the winner row for each inbox slot is recovered WITHOUT a scatter:
+  per-destination counts are nondecreasing, so slot s's source row is
+  a ``searchsorted`` probe (log N vectorized gathers), and the payload
+  lands via 12 dense gathers straight into the stacked [R, capacity]
+  inboxes.
+
+Row order per destination is pooled-row order — byte-identical to the
+old fabric (tests/test_route_fabric.py pins it, and the golden kernel
+fixtures pin it through whole cluster scenarios), including the
+overflow-drop-beyond-capacity semantics (legal message loss).
+
+``prefix_pack_plan`` is the 1-destination special case used by the
+inbox compaction step (models/cluster.py ``compact_inbox``): pack live
+rows to a prefix at a smaller static capacity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["route_plan", "gather_rows", "prefix_pack_plan"]
+
+
+def route_plan(kind_flat: jnp.ndarray, src_rep: jnp.ndarray,
+               fdst: jnp.ndarray, alive: jnp.ndarray,
+               capacity: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Routing plan over the pooled outbox rows.
+
+    kind_flat/src_rep/fdst: [N] pooled rows (N = R·M, row i's sender is
+    src_rep[i]); fdst semantics: -1 broadcast to all other live
+    replicas, 0..R-1 unicast, anything else (e.g. -2 client-bound)
+    excluded. alive: bool[R] — dead senders' rows drop, dead
+    destinations receive nothing.
+
+    Returns (win, hit): win[d, s] = pooled-row index filling slot s of
+    destination d's inbox (rows keep pooled order; slots beyond the
+    destination's row count, and rows beyond ``capacity``, are unfilled
+    / dropped), hit[d, s] = slot filled.
+    """
+    r = alive.shape[0]
+    n = kind_flat.shape[0]
+    live = (kind_flat != 0) & alive[src_rep]
+    isbc = live & (fdst == -1)
+    isun = live & (fdst >= 0) & (fdst < r) & (fdst != src_rep)
+    dests = jnp.arange(r, dtype=jnp.int32)[:, None]
+    # destination plane: row i lands in inbox d iff it broadcasts from
+    # another replica or unicasts to d — [R, N] index arithmetic only,
+    # never the payload columns
+    destined = ((isbc[None, :] & (src_rep[None, :] != dests))
+                | (isun[None, :] & (fdst[None, :] == dests))
+                ) & alive[:, None]
+    # the single segment-prefix-sum: cnt[d, i] = rows destined to d
+    # among pooled rows 0..i (inclusive) — each destined row's inbox
+    # offset is its own cnt - 1
+    cnt = jnp.cumsum(destined.astype(jnp.int32), axis=1)
+    # winner WITHOUT a scatter: cnt[d] is nondecreasing, so the row
+    # landing at slot s is the first with cnt == s + 1
+    want = jnp.arange(1, capacity + 1, dtype=jnp.int32)
+    win = jax.vmap(lambda c: jnp.searchsorted(c, want))(cnt)
+    win = win.astype(jnp.int32)
+    return win, win < n
+
+
+def gather_rows(flat_tree, win: jnp.ndarray, hit: jnp.ndarray):
+    """Materialize the planned inboxes: 12 dense gathers of the pooled
+    columns at the winning rows; unfilled slots are zero (padding)."""
+    winc = jnp.where(hit, win, 0)
+
+    def one(col):
+        picked = col[winc]
+        z = jnp.zeros(win.shape, col.dtype)
+        if picked.dtype != col.dtype:  # pragma: no cover - same dtype
+            picked = picked.astype(col.dtype)
+        return jnp.where(hit, picked, z)
+
+    return jax.tree_util.tree_map(one, flat_tree)
+
+
+def prefix_pack_plan(live: jnp.ndarray,
+                     capacity: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """1-D compaction plan: pack rows where ``live`` to a prefix of a
+    ``capacity``-row buffer (order preserved, overflow dropped).
+
+    Returns (win, hit) exactly like ``route_plan`` but for one
+    destination: win[s] = source row of packed slot s.
+    """
+    n = live.shape[0]
+    cnt = jnp.cumsum(live.astype(jnp.int32))
+    want = jnp.arange(1, capacity + 1, dtype=jnp.int32)
+    win = jnp.searchsorted(cnt, want).astype(jnp.int32)
+    return win, win < n

@@ -38,6 +38,7 @@ from minpaxos_tpu.obs.recorder import (
     TEL_COMMITTED,
     TEL_FIELD_NAMES,
     TEL_IN_FLIGHT,
+    TEL_INBOX_HWM,
     TEL_INBOX_ROWS,
     TEL_INJECTED,
     TEL_PREPARED,
@@ -48,7 +49,8 @@ __all__ = ["telemetry_row", "N_TEL_FIELDS", "TEL_FIELD_NAMES"]
 
 
 def telemetry_row(round_idx, committed_delta, in_flight, assigned,
-                  injected_rows, inbox_rows, claim_rows, prepared_shards):
+                  injected_rows, inbox_rows, claim_rows, prepared_shards,
+                  inbox_hwm):
     """One ``[N_TEL_FIELDS]`` int32 telemetry row, field order pinned
     to the obs/recorder.py layout (asserted below at import time, and
     against TEL_FIELD_NAMES by tests/test_paxray.py).
@@ -67,6 +69,7 @@ def telemetry_row(round_idx, committed_delta, in_flight, assigned,
         TEL_INBOX_ROWS: inbox_rows,
         TEL_CLAIM_ROWS: claim_rows,
         TEL_PREPARED: prepared_shards,
+        TEL_INBOX_HWM: inbox_hwm,
     }
     assert sorted(fields) == list(range(N_TEL_FIELDS))
     return jnp.stack([jnp.asarray(fields[i], jnp.int32)

@@ -218,10 +218,14 @@ def sharded_run(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
         ext = assemble_batch(cfg.n_replicas, n_shards, ext_rows,
                              n_proposals, leader, round0 + t, key_t, val_t)
         ss, _, _, _ = jax.vmap(cstep)(ss, ext)
+        # drain-only sub-steps: deliver queued traffic, no new work —
+        # the ext batch is ZERO-WIDTH, not zero-filled, so the kernel
+        # (and the routed pool behind it) runs at the inbox capacity
+        # alone instead of inbox + ext_rows; an all-padding ext region
+        # was inert anyway, so the commit stream is unchanged (PR 11)
+        ext0 = jax.tree_util.tree_map(lambda x: x[..., :0], ext)
         for _ in range(substeps - 1):
-            # drain-only sub-step: deliver queued traffic, no new work
-            ss, _, _, _ = jax.vmap(cstep)(
-                ss, jax.tree_util.tree_map(jnp.zeros_like, ext))
+            ss, _, _, _ = jax.vmap(cstep)(ss, ext0)
         return ss, (ss.states.committed_upto[:, cursor_rep],
                     ss.states.crt_inst[:, cursor_rep])
 
@@ -300,14 +304,34 @@ def sharded_run_resident(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
         c_prev = ss.states.crt_inst[:, cursor_rep]
         if tel_on:
             e_prev = ss.states.executed_upto[:, cursor_rep]
-            # routed peer rows awaiting delivery = this round's inbox
-            inbox_rows = (ss.pending.kind != 0).sum()
+            # routed peer rows awaiting delivery = this round's inbox;
+            # the max per-(shard, replica) DELIVERED rows (routed +
+            # injected — injection has a closed form, see `injected`
+            # below) is the occupancy one inbox must hold: its run
+            # high-water mark feeds adaptive capacity selection
+            # (TEL_INBOX_HWM -> shape_ladder's inbox axis, PR 11)
+            pending_live = (ss.pending.kind != 0).sum(axis=-1)
+            inbox_rows = pending_live.sum()
+            ext_live = jnp.where(
+                (jnp.arange(cfg.n_replicas) == leader) | (leader < 0),
+                n_proposals, 0)
+            inbox_hwm = (pending_live + ext_live[None, :]).max()
         ext = assemble_batch(cfg.n_replicas, n_shards, ext_rows,
                              n_proposals, leader, r, key_t, val_t)
         ss, _, _, _ = jax.vmap(cstep)(ss, ext)
+        # zero-WIDTH drain sub-steps (see sharded_run): smaller static
+        # kernel shape, identical commit stream
+        ext0 = jax.tree_util.tree_map(lambda x: x[..., :0], ext)
         for _ in range(substeps - 1):
-            ss, _, _, _ = jax.vmap(cstep)(
-                ss, jax.tree_util.tree_map(jnp.zeros_like, ext))
+            if tel_on:
+                # drain sub-steps deliver pending rows too: fold each
+                # drain delivery into the round's sum and hwm, or a
+                # substeps>1 run undercounts the occupancy that sizes
+                # adaptive capacity (TEL_INBOX_HWM)
+                drain_live = (ss.pending.kind != 0).sum(axis=-1)
+                inbox_rows = inbox_rows + drain_live.sum()
+                inbox_hwm = jnp.maximum(inbox_hwm, drain_live.max())
+            ss, _, _, _ = jax.vmap(cstep)(ss, ext0)
         u_new = ss.states.committed_upto[:, cursor_rep]
         c_new = ss.states.crt_inst[:, cursor_rep]
         # stamp this round on slots assigned this round: [c_prev, c_new)
@@ -340,7 +364,8 @@ def sharded_run_resident(cfg: MinPaxosConfig, n_shards: int, ext_rows: int,
                 inbox_rows=inbox_rows,
                 claim_rows=(ss.states.executed_upto[:, cursor_rep]
                             - e_prev).sum(),
-                prepared_shards=prep)
+                prepared_shards=prep,
+                inbox_hwm=inbox_hwm)
             tel = jax.lax.dynamic_update_index_in_dim(
                 tel, row, jnp.mod(r - tel_base, telemetry.shape[0]), 0)
         return (ss, inj, hist, tel), None

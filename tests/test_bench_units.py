@@ -432,3 +432,44 @@ def test_fresh_ladder_record_marks_measured(monkeypatch):
     final = json.loads(out[-1][0])
     assert final["value"] == 100.0
     assert final["measured_this_run"] is True
+
+
+# -- shape_ladder adaptive-capacity policy (PR 11): pure helpers, no
+# compile — the measured behavior is gated by the tier-1 ladder smoke
+
+
+def test_adaptive_capacity_policy():
+    from tools.shape_ladder import adaptive_capacity
+
+    # hwm + 25% headroom, rounded up to 32; floor of 64
+    assert adaptive_capacity(49) == 96
+    assert adaptive_capacity(0) == 64
+    assert adaptive_capacity(1281) == 1632
+    for hwm in (1, 31, 32, 100, 500, 4096):
+        cap = adaptive_capacity(hwm)
+        assert cap % 32 == 0 and cap >= hwm + hwm // 4
+        assert cap >= 64
+
+
+def test_ladder_legality_contract():
+    """Base points keep the PR-8/9 bar (drain-exact); adaptive points
+    must additionally show no capacity-attributable loss — absolute
+    lossless OR equal-to-base committed totals (deep-pipeline shapes
+    bounce proposals off the full window at ANY capacity)."""
+    from tools.shape_ladder import _legal
+
+    base_lossy = {"drained_exact": True, "lossless": False}
+    assert _legal(base_lossy)  # window bounce, not a capacity fault
+    assert not _legal({"drained_exact": False, "lossless": True})
+    assert not _legal({"drained_exact": True, "error": "boom"})
+    adaptive_clean = {"drained_exact": True, "adaptive": True,
+                      "lossless": True}
+    assert _legal(adaptive_clean)
+    adaptive_vs_base = {"drained_exact": True, "adaptive": True,
+                        "lossless": False, "lossless_vs_base": True}
+    assert _legal(adaptive_vs_base)
+    adaptive_lossy = {"drained_exact": True, "adaptive": True,
+                      "lossless": False}
+    assert not _legal(adaptive_lossy)  # capacity dropped proposals
+    mencius_base = {"drained_exact": True, "lossless": None}
+    assert _legal(mencius_base)

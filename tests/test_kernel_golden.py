@@ -1,0 +1,149 @@
+"""Golden byte-equality pin of the consensus kernels + routing fabric.
+
+The PR-11 hot-path rewrite (segmented routing fabric, fused per-kind
+slot writes) must be BYTE-IDENTICAL to the kernels it replaces: the
+fixtures here were generated from the pre-rewrite tree (PR 9 HEAD,
+``python tests/test_kernel_golden.py`` regenerates) and record a
+blake2b digest of the FULL cluster state — stacked replica states,
+routed pending inboxes, alive mask — after every step of a scenario
+that drives all three protocols through elections, mixed
+broadcast/unicast/client-bound traffic, inbox overflow, majority loss
+(kill 3 of 5), stalled-frontier retries, revival and a mid-run leader
+change. Any semantic drift in the step kernels OR the routing fabric
+changes a digest; the test names the first divergent step.
+
+This is deliberately stronger than output-level checks: the pending
+inboxes pin the fabric's exact row ORDER (ack-run compression and
+winner tie-breaks depend on it), and the per-step digests localize a
+divergence to the step that introduced it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+if __name__ == "__main__":  # direct regen run: mirror conftest's env
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import pytest
+
+from minpaxos_tpu.models.cluster import Cluster
+from minpaxos_tpu.models.mencius import MenciusCluster
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+from minpaxos_tpu.models.paxos import classic_config
+from minpaxos_tpu.wire.messages import Op
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "kernel_golden.json")
+
+_KW = dict(n_replicas=5, window=64, inbox=32, exec_batch=16, kv_pow2=8,
+           catchup_rows=8, recovery_rows=8)
+
+
+def _digest(cs) -> str:
+    import jax
+
+    h = hashlib.blake2b(digest_size=16)
+    for leaf in jax.tree_util.tree_leaves((cs.states, cs.pending, cs.alive)):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _drive(protocol: str) -> list[str]:
+    """Deterministic mixed-traffic scenario; one digest per step."""
+    if protocol == "mencius":
+        cl = MenciusCluster(MinPaxosConfig(**_KW), ext_rows=8)
+    else:
+        cfg = (classic_config(**_KW) if protocol == "classic"
+               else MinPaxosConfig(**_KW))
+        cl = Cluster(cfg, ext_rows=8)
+    rng = np.random.default_rng(7)
+    digests = []
+
+    def step(n=1):
+        for _ in range(n):
+            cl.step()
+            digests.append(_digest(cl.cs))
+
+    def propose(n, client, to):
+        keys = rng.integers(0, 40, n)
+        vals = rng.integers(0, 1 << 16, n)
+        ops = np.where(rng.random(n) < 0.7, int(Op.PUT), int(Op.GET))
+        mids = np.arange(n) + len(digests) * 100 + client * 10_000
+        cl.propose(ops, keys, vals, mids, client_id=client, to=to)
+
+    if protocol != "mencius":
+        cl.elect(0)
+        step(2)  # deliver PREPAREs + replies -> prepared
+        propose(20, client=1, to=0)  # chunked: 8+8+4 ext rows
+        propose(5, client=2, to=0)
+        step(6)
+        cl.kill(2)
+        propose(6, client=1, to=0)
+        step(4)
+        cl.kill(1)
+        cl.kill(3)  # majority lost: frontier stalls, retries fire
+        propose(4, client=2, to=0)
+        step(8)
+        cl.revive(1)
+        cl.revive(2)
+        cl.revive(3)
+        step(6)
+        cl.elect(1)  # leader change: PIR sweep over the old tenure
+        step(3)
+        propose(6, client=1, to=1)
+        step(8)
+    else:
+        propose(10, client=1, to=0)
+        propose(7, client=2, to=1)
+        step(6)
+        cl.kill(2)
+        propose(6, client=1, to=3)
+        step(6)
+        cl.kill(1)
+        cl.kill(3)
+        propose(4, client=2, to=0)
+        step(8)
+        cl.revive(1)
+        cl.revive(2)
+        cl.revive(3)
+        step(10)
+        propose(5, client=1, to=2)
+        step(8)
+    return digests
+
+
+PROTOCOLS = ("minpaxos", "classic", "mencius")
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_kernel_golden(protocol):
+    with open(FIXTURE) as f:
+        golden = json.load(f)
+    got = _drive(protocol)
+    want = golden[protocol]
+    assert len(got) == len(want), (
+        f"{protocol}: scenario length changed ({len(got)} vs {len(want)}) "
+        f"— the golden scenario must not be edited without regenerating")
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g == w, (
+            f"{protocol}: state digest diverged at step {i} "
+            f"(first {sum(a == b for a, b in zip(got, want))}/{len(want)} "
+            f"match) — the rewritten kernel/fabric is no longer "
+            f"byte-identical to the pre-rewrite tree")
+
+
+if __name__ == "__main__":
+    out = {p: _drive(p) for p in PROTOCOLS}
+    os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
+    with open(FIXTURE, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {FIXTURE}: " + ", ".join(
+        f"{p}={len(d)} steps" for p, d in out.items()))

@@ -34,6 +34,7 @@ from minpaxos_tpu.obs.recorder import (
     TEL_COMMITTED,
     TEL_FIELD_NAMES,
     TEL_IN_FLIGHT,
+    TEL_INBOX_HWM,
     TEL_INBOX_ROWS,
     TEL_INJECTED,
     TEL_PREPARED,
@@ -80,7 +81,7 @@ def test_telemetry_row_layout_pinned_to_recorder():
     per-field values must land each value at its named index."""
     vals = dict(round_idx=10, committed_delta=11, in_flight=12,
                 assigned=13, injected_rows=14, inbox_rows=15,
-                claim_rows=16, prepared_shards=17)
+                claim_rows=16, prepared_shards=17, inbox_hwm=18)
     row = np.asarray(telemetry_row(**vals))
     assert row.shape == (N_TEL_FIELDS,) and row.dtype == np.int32
     assert len(TEL_FIELD_NAMES) == N_TEL_FIELDS
@@ -88,6 +89,7 @@ def test_telemetry_row_layout_pinned_to_recorder():
     assert row[TEL_IN_FLIGHT] == 12 and row[TEL_ASSIGNED] == 13
     assert row[TEL_INJECTED] == 14 and row[TEL_INBOX_ROWS] == 15
     assert row[TEL_CLAIM_ROWS] == 16 and row[TEL_PREPARED] == 17
+    assert row[TEL_INBOX_HWM] == 18
 
 
 # ------------------------------------------------------ parity / purity
@@ -153,6 +155,18 @@ def test_telemetry_content_semantics():
     assert (tel[12:, TEL_INJECTED] == 0).all()
     assert tel[0, TEL_INBOX_ROWS] == 0  # nothing routed before round 1
     assert (tel[1:12, TEL_INBOX_ROWS] > 0).all()
+    # the occupancy column feeding adaptive capacity (PR 11): the max
+    # DELIVERED per-inbox load (routed + injected) is bounded by the
+    # cross-cluster totals and by the static capacity, positive
+    # exactly when anything was delivered — and round 0 (nothing
+    # routed yet, p rows injected at the leader) pins the injected
+    # contribution exactly
+    assert ((tel[:, TEL_INBOX_HWM]
+             <= tel[:, TEL_INBOX_ROWS] + tel[:, TEL_INJECTED]).all()
+            and (tel[:, TEL_INBOX_HWM] <= SMALL.inbox + 32).all())
+    assert ((tel[:, TEL_INBOX_HWM] > 0)
+            == ((tel[:, TEL_INBOX_ROWS] + tel[:, TEL_INJECTED]) > 0)).all()
+    assert tel[0, TEL_INBOX_HWM] == p
     assert int(tel[:, TEL_CLAIM_ROWS].sum()) <= committed
     assert int(tel[:, TEL_COMMITTED].sum()) == committed
 
@@ -266,7 +280,7 @@ def test_reserved_pid_is_enforced():
 def test_device_round_events_skips_uncovered_rounds():
     """Rounds with no covering dispatch (telemetry of a window the
     host never logged) are skipped, not misplaced at t=0."""
-    row = np.asarray(telemetry_row(5, 1, 2, 3, 4, 5, 6, 2))[None]
+    row = np.asarray(telemetry_row(5, 1, 2, 3, 4, 5, 6, 2, 3))[None]
     evs = device_round_events(row, [{"t0_ns": 0, "t1_ns": 1000,
                                      "round0": 99, "k": 2}], n_shards=2)
     assert evs == []

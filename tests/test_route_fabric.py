@@ -1,0 +1,203 @@
+"""Byte-equality pin of the segmented routing fabric (PR 11).
+
+``_route_segmented`` (one segment-prefix-sum + searchsorted winner,
+ops/segscatter.py) must reproduce the original dense fabric
+(``_route``) BYTE-FOR-BYTE: same rows, same per-destination order,
+same overflow-drop semantics — ack-run compression and winner
+tie-breaks read row order, so "equivalent but reordered" is not good
+enough. The old fabric stays in-tree behind
+``route_fabric="dense"`` exactly so this pin owns the rewrite; the
+golden kernel fixtures (tests/test_kernel_golden.py) extend the pin
+through whole multi-protocol cluster scenarios.
+
+Also here: the inbox-compaction step (``compact_inbox``) — NOT
+byte-equal at the frame level by design (padding gaps vanish, ack
+runs may merge) — must leave the protocol STATE byte-identical when
+capacity covers occupancy, across all three protocols.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.cluster import (
+    Cluster,
+    _route,
+    _route_segmented,
+)
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig, MsgBatch
+from minpaxos_tpu.wire.messages import MsgKind, Op
+
+R = 5
+
+
+def _mk_outboxes(m, n_live, seed, bc_frac=0.5, uni_frac=0.3):
+    """Random [R, m] outboxes: n_live live rows each, dst mixing
+    broadcast (-1), unicast (0..R-1, self included), client (-2)."""
+    rng = np.random.default_rng(seed)
+    cols = {f: np.zeros((R, m), np.int32) for f in MsgBatch._fields}
+    dst = np.full((R, m), -1, np.int32)
+    for r in range(R):
+        # scatter live rows across positions, not only a prefix: the
+        # fabric must compact arbitrary gap patterns
+        pos = np.sort(rng.choice(m, size=n_live, replace=False))
+        cols["kind"][r, pos] = rng.integers(1, 10, n_live)
+        for f in MsgBatch._fields:
+            if f != "kind":
+                cols[f][r, pos] = rng.integers(-5, 1 << 20, n_live)
+        u = rng.random(n_live)
+        dst[r, pos] = np.where(
+            u < bc_frac, -1,
+            np.where(u < bc_frac + uni_frac, rng.integers(0, R, n_live), -2))
+    msgs = MsgBatch(**{f: jnp.asarray(v) for f, v in cols.items()})
+    return msgs, jnp.asarray(dst)
+
+
+def _assert_tree_equal(a, b, ctx=""):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=ctx)
+
+
+@pytest.mark.parametrize("m,n_live,capacity", [
+    (32, 16, 32),    # ordinary mix
+    (32, 32, 16),    # heavy overflow: fan-out far beyond capacity
+    (64, 3, 64),     # sparse
+    (16, 16, 128),   # capacity beyond pool: all rows land, tail empty
+])
+def test_segmented_matches_dense(m, n_live, capacity):
+    cfg = MinPaxosConfig(n_replicas=R, window=64, inbox=capacity)
+    for seed in range(4):
+        msgs, dst = _mk_outboxes(m, n_live, seed)
+        alive = jnp.ones(R, bool)
+        _assert_tree_equal(
+            _route(cfg, msgs, dst, alive, capacity),
+            _route_segmented(cfg, msgs, dst, alive, capacity),
+            ctx=f"seed={seed}")
+
+
+def test_segmented_matches_dense_dead_replicas():
+    """Dead sources' rows drop; dead destinations receive zeroed
+    inboxes — every alive-mask combination at N=5 (jitted once,
+    alive as a runtime arg: 32 masks, 2 compiles)."""
+    import jax as _jax
+
+    cfg = MinPaxosConfig(n_replicas=R, window=64, inbox=24)
+    msgs, dst = _mk_outboxes(24, 18, seed=3)
+    dense = _jax.jit(lambda a: _route(cfg, msgs, dst, a, 24))
+    seg = _jax.jit(lambda a: _route_segmented(cfg, msgs, dst, a, 24))
+    for mask in range(1 << R):
+        alive = jnp.asarray([(mask >> i) & 1 == 1 for i in range(R)])
+        _assert_tree_equal(dense(alive), seg(alive),
+                           ctx=f"alive={mask:05b}")
+
+
+def test_broadcast_unicast_client_semantics():
+    """Hand-built outbox: broadcast reaches all OTHER live replicas,
+    unicast exactly its target, client-bound (-2) rows never route,
+    and per-destination order is pooled-row order."""
+    cfg = MinPaxosConfig(n_replicas=R, window=64, inbox=8)
+    cols = {f: np.zeros((R, 4), np.int32) for f in MsgBatch._fields}
+    dst = np.full((R, 4), -2, np.int32)
+    # replica 0: row0 broadcast, row1 unicast->3, row2 client, row3 pad
+    cols["kind"][0, :3] = [int(MsgKind.ACCEPT), int(MsgKind.PREPARE_REPLY),
+                           int(MsgKind.PROPOSE_REPLY)]
+    cols["cmd_id"][0, :3] = [100, 101, 102]
+    dst[0, :3] = [-1, 3, -2]
+    # replica 2: row0 unicast->3 (lands AFTER replica 0's rows), row1
+    # unicast->2 (self: dropped)
+    cols["kind"][2, :2] = [int(MsgKind.ACCEPT_REPLY), int(MsgKind.COMMIT)]
+    cols["cmd_id"][2, :2] = [200, 201]
+    dst[2, :2] = [3, 2]
+    msgs = MsgBatch(**{f: jnp.asarray(v) for f, v in cols.items()})
+    alive = jnp.ones(R, bool)
+    got = _route_segmented(cfg, msgs, jnp.asarray(dst), alive, 8)
+    kind = np.asarray(got.kind)
+    cid = np.asarray(got.cmd_id)
+    # replica 0's broadcast reaches 1..4 but not 0
+    assert kind[0, 0] == 0
+    for d in (1, 2, 4):
+        assert kind[d, 0] == int(MsgKind.ACCEPT) and cid[d, 0] == 100
+        assert kind[d, 1] == 0  # nothing else routed there
+    # replica 3: broadcast first (pooled order), then the two unicasts
+    assert list(kind[3, :3]) == [int(MsgKind.ACCEPT),
+                                 int(MsgKind.PREPARE_REPLY),
+                                 int(MsgKind.ACCEPT_REPLY)]
+    assert list(cid[3, :3]) == [100, 101, 200]
+    # client-bound + self-unicast rows route nowhere
+    assert not (cid == 102).any() and not (cid == 201).any()
+
+
+def test_overflow_drops_beyond_capacity():
+    """More addressed rows than capacity: exactly the first
+    ``capacity`` rows (pooled order) land, the rest drop silently."""
+    cfg = MinPaxosConfig(n_replicas=R, window=64, inbox=4)
+    m = 8
+    cols = {f: np.zeros((R, m), np.int32) for f in MsgBatch._fields}
+    cols["kind"][0, :] = int(MsgKind.ACCEPT)
+    cols["cmd_id"][0, :] = np.arange(m) + 1
+    dst = np.full((R, m), -2, np.int32)
+    dst[0, :] = 1  # 8 unicasts at capacity 4
+    msgs = MsgBatch(**{f: jnp.asarray(v) for f, v in cols.items()})
+    alive = jnp.ones(R, bool)
+    got = _route_segmented(cfg, msgs, jnp.asarray(dst), alive, 4)
+    assert list(np.asarray(got.cmd_id)[1]) == [1, 2, 3, 4]
+    _assert_tree_equal(got, _route(cfg, msgs, jnp.asarray(dst), alive, 4))
+
+
+@pytest.mark.parametrize("protocol", ["minpaxos", "classic", "mencius"])
+def test_compaction_state_equivalence(protocol):
+    """compact_inbox at adequate capacity: the protocol STATE (and so
+    the commit stream) stays byte-identical to the uncompacted run;
+    only the inbox frame layout differs. Exercises kill/revive so
+    dead-replica zeroing composes with the pack.
+
+    Deliberately reuses test_kernel_golden's exact config + ext width:
+    the uncompacted legs then share the golden scenarios' compiled
+    ``cluster_step`` (same static cfg, same shapes — one in-process
+    jit cache), so this test only pays the 3 compacted-variant
+    compiles (tier-1 budget discipline)."""
+    from minpaxos_tpu.models.paxos import classic_config
+
+    from tests.test_kernel_golden import _KW
+
+    def build(compact):
+        kw = dict(_KW, compact_inbox=compact) if compact else dict(_KW)
+        cfg = (classic_config(**kw) if protocol == "classic"
+               else MinPaxosConfig(**kw))
+        if protocol == "mencius":
+            from minpaxos_tpu.models.mencius import MenciusCluster
+
+            return MenciusCluster(cfg, ext_rows=8)
+        return Cluster(cfg, ext_rows=8)
+
+    def drive(cl):
+        rng = np.random.default_rng(11)
+        if protocol != "mencius":
+            cl.elect(0)
+            cl.step()
+            cl.step()
+        for i in range(10):
+            if i == 4:
+                cl.kill(2)
+            if i == 7:
+                cl.revive(2)
+            n = 5
+            cl.propose(np.full(n, int(Op.PUT)), rng.integers(0, 30, n),
+                       rng.integers(0, 99, n), np.arange(n) + i * 10,
+                       client_id=1, to=0)
+            cl.step()
+        for _ in range(6):
+            cl.step()
+        return cl
+
+    # compacted capacity 36 < inbox + ext = 40, >= this load's occupancy
+    a = drive(build(0))
+    b = drive(build(36))
+    _assert_tree_equal(a.cs.states, b.cs.states,
+                       ctx=f"{protocol}: state diverged under compaction")
+    assert a.replies == b.replies

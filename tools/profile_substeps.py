@@ -25,8 +25,13 @@ kernels — NOT re-implementations):
 * ``empty``   — the same kernel on an all-padding inbox: the fixed
   per-round floor (commit scan, exec gate, window slide) every round
   pays regardless of traffic;
-* ``route``   — the pod-mode routing fabric (models/cluster._route):
-  pool all outboxes, cumsum-scatter each replica's next inbox;
+* ``route``   — the ORIGINAL dense routing fabric (models/cluster.
+  _route, kept behind ``route_fabric="dense"``): pool all outboxes,
+  cumsum-scatter each replica's next inbox — measured so the PR-9 fit
+  stays comparable across the PR-11 rewrite;
+* ``route_v2`` — the one-pass segmented fabric (_route_segmented /
+  ops/segscatter.py) the cluster actually runs: one segment-prefix-sum
+  + searchsorted winner, no per-destination scatter;
 * ``apply``   — the KV claim/apply path (ops/kvstore.kv_apply_batch:
   lexsort, segmented scans, two-choice claim rounds) per exec row.
 
@@ -56,7 +61,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-from minpaxos_tpu.models.cluster import _route  # noqa: E402
+from minpaxos_tpu.models.cluster import _route, _route_segmented  # noqa: E402
 from minpaxos_tpu.models.minpaxos import (  # noqa: E402
     MinPaxosConfig,
     MsgBatch,
@@ -161,12 +166,16 @@ def profile_capacity(cfg: MinPaxosConfig, live: int, iters: int) -> dict:
     dst = jnp.full((r, m), -1, jnp.int32)
     alive = jnp.ones(r, dtype=bool)
 
-    def route_fn(msgs, d, a):
-        return _route(cfg, msgs, d, a, m)
-
-    route = jax.jit(route_fn)
+    # both fabrics at the same inputs: "route" (dense, the PR-9 fit's
+    # subject) stays comparable across the rewrite, "route_v2" is the
+    # segmented fabric the cluster actually runs (PR 11)
+    route = jax.jit(lambda msgs, d, a: _route(cfg, msgs, d, a, m))
     out["route"] = _time_ms(
         lambda: jax.block_until_ready(route(omsgs, dst, alive)), iters)
+    route2 = jax.jit(
+        lambda msgs, d, a: _route_segmented(cfg, msgs, d, a, m))
+    out["route_v2"] = _time_ms(
+        lambda: jax.block_until_ready(route2(omsgs, dst, alive)), iters)
 
     # KV claim/apply path at batch size m — the batch axis IS the
     # swept dimension for this kernel, so it must equal the fit's x
@@ -211,9 +220,12 @@ def main(argv=None) -> int:
                          "fitted across these)")
     ap.add_argument("--window", type=int, default=512,
                     help="log window (the bench's CPU shape)")
-    ap.add_argument("--iters", type=int, default=15,
+    ap.add_argument("--iters", type=int, default=40,
                     help="timing iterations per point (min is kept — "
-                         "see _time_ms)")
+                         "see _time_ms). Raised 15 -> 40 in PR 11: the "
+                         "PR-9 table's accept/empty fits bottomed out "
+                         "at r2 0.71/0.77, too noisy for before/after "
+                         "claims on a shared host")
     ap.add_argument("--json", default="",
                     help="write the cost table as JSON here")
     args = ap.parse_args(argv)
@@ -239,6 +251,7 @@ def main(argv=None) -> int:
             print(f"  {name:10s} {ms:8.3f} ms/step")
 
     table = {}
+    bad_fits = []
     print(f"\n== per-row cost (fit over capacities {args.rows}, "
           f"window {args.window}, platform {platform}) ==")
     for name, pts in sweep.items():
@@ -246,8 +259,18 @@ def main(argv=None) -> int:
         fit = fit_per_row(caps, [pts[c] for c in caps])
         table[name] = {"ms_by_capacity": {str(c): round(pts[c], 3)
                                           for c in caps}, **fit}
+        flag = ""
+        if fit["r2"] < 0.9:
+            flag = "  <-- NOISY FIT (r2 < 0.9)"
+            bad_fits.append(name)
         print(f"  {name:10s} {fit['per_row_us']:8.2f} us/row "
-              f"(+{fit['fixed_ms']:.3f} ms fixed, r2={fit['r2']})")
+              f"(+{fit['fixed_ms']:.3f} ms fixed, r2={fit['r2']}){flag}")
+    if bad_fits:
+        print(f"\nWARNING: fits below r2=0.9: {', '.join(bad_fits)} — "
+              f"their per_row_us/fixed_ms are NOT trustworthy for "
+              f"before/after claims. Re-run with a higher --iters on a "
+              f"quiet host (min-of-N only rejects noise it gets enough "
+              f"samples to see).", flush=True)
 
     result = {
         "platform": platform,
@@ -256,11 +279,15 @@ def main(argv=None) -> int:
         "capacities": args.rows,
         "iters": args.iters,
         "substeps": table,
+        "fits_below_r2_0_9": bad_fits,
         "note": "branch-free masked kernels: cost scales with inbox "
                 "CAPACITY rows; live-row count only changes data. "
                 "'empty' is the fixed per-round floor (commit scan, "
                 "exec gate, slide) and also scales with capacity "
-                "through the outbox/concat shapes.",
+                "through the outbox/concat shapes. 'route' is the "
+                "retired dense fabric (route_fabric='dense', kept for "
+                "comparability); 'route_v2' is the segmented fabric "
+                "the cluster runs (PR 11).",
     }
     if args.json:
         with open(args.json, "w") as f:

@@ -43,8 +43,13 @@ env JAX_PLATFORMS=cpu python tools/mc.py --smoke || exit 1
 # points through the fully device-resident measured loop — commits
 # flow, the drain is exact (in-flight == 0: the latency-accounting
 # contract), the on-device latency histogram is populated, and the
-# autotuner picks a winner (PERF.md resident-loop section). Budgeted
-# <= 60 s including the jit compile of both points.
+# autotuner picks a winner (PERF.md resident-loop section). The second
+# point runs with OCCUPANCY-ADAPTIVE capacity on (PR 11): its inbox is
+# derived from the first point's measured occupancy high-water mark
+# (paxray TEL_INBOX_HWM, read on the sanctioned post-window path) with
+# the kernel inbox compacted to it, and must additionally be LOSSLESS
+# (no proposal dropped) — still exactly two compiled dispatch
+# variants. Budgeted <= 60 s including the jit compile of both.
 echo "== shape-ladder smoke (2-point resident-loop sweep, drain-exact) =="
 env JAX_PLATFORMS=cpu python tools/shape_ladder.py --smoke || exit 1
 

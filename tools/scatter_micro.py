@@ -98,6 +98,42 @@ def main() -> None:
     ms1 = _time(scatter_onecol, old, tgt, cols)
     print(f"d. single-column scatter     {ms1:9.2f} ms")
 
+    # -- routing fabric: dense pool-per-destination vs one-pass
+    # segmented (PR 11). The dense fabric is a masked cumsum + scatter
+    # per destination over the [R·M] pool; the segmented one is one
+    # segment-prefix-sum + a searchsorted winner + 12 dense gathers
+    # (ops/segscatter.py). Same inputs, byte-identical outputs
+    # (tests/test_route_fabric.py) — this leg isolates the (a)
+    # rewrite's win from the rest of the round.
+    from minpaxos_tpu.models.cluster import _route, _route_segmented
+    from minpaxos_tpu.models.minpaxos import MinPaxosConfig, MsgBatch
+
+    r_f = 5
+    for m_f in (256, 1024):
+        cfg = MinPaxosConfig(n_replicas=r_f, window=512, inbox=m_f)
+        n_live = m_f // 2
+        cols_f = {f: np.zeros((r_f, m_f), np.int32)
+                  for f in MsgBatch._fields}
+        dst_f = np.full((r_f, m_f), -1, np.int32)
+        for rr in range(r_f):
+            cols_f["kind"][rr, :n_live] = 1 + rng.integers(0, 8, n_live)
+            u = rng.random(n_live)
+            dst_f[rr, :n_live] = np.where(
+                u < 0.6, -1, np.where(u < 0.85,
+                                      rng.integers(0, r_f, n_live), -2))
+        msgs = MsgBatch(**{f: jnp.asarray(v) for f, v in cols_f.items()})
+        dstj = jnp.asarray(dst_f)
+        alive = jnp.ones(r_f, bool)
+        dense = jax.jit(lambda a, b, c, _cfg=cfg, _m=m_f:
+                        _route(_cfg, a, b, c, _m))
+        seg = jax.jit(lambda a, b, c, _cfg=cfg, _m=m_f:
+                      _route_segmented(_cfg, a, b, c, _m))
+        ms_d = _time(dense, msgs, dstj, alive)
+        ms_s = _time(seg, msgs, dstj, alive)
+        print(f"e. route dense  (R=5,M={m_f:5d}) {ms_d:9.2f} ms")
+        print(f"f. route segmented   (same)  {ms_s:9.2f} ms "
+              f"({ms_d / ms_s:.1f}x)")
+
 
 if __name__ == "__main__":
     main()

@@ -60,12 +60,15 @@ from minpaxos_tpu.parallel import make_mesh  # noqa: E402
 from minpaxos_tpu.parallel.sharded import ShardedCluster  # noqa: E402
 
 
-def point_config(protocol: str, w: int, p: int) -> MinPaxosConfig:
+def point_config(protocol: str, w: int, p: int, inbox: int | None = None,
+                 compact: int = 0) -> MinPaxosConfig:
     cu = cpu_catchup_rows(p, fault=False)
     kw = dict(n_replicas=5, window=w, inbox=p + 2 * cu + 64 + 64,
               exec_batch=p, kv_pow2=cpu_kv_pow2(p), catchup_rows=cu,
-              recovery_rows=64)
+              recovery_rows=64, compact_inbox=compact)
     if protocol == "classic":
+        if inbox is not None:
+            kw["inbox"] = inbox
         return classic_config(**kw)
     if protocol == "mencius":
         # per-step commit-broadcast chunk must beat the per-owner
@@ -73,17 +76,40 @@ def point_config(protocol: str, w: int, p: int) -> MinPaxosConfig:
         kw["catchup_rows"] = max(kw["catchup_rows"], 2 * p)
         kw["inbox"] = max(kw["inbox"], 4 * p)
         kw["noop_delay"] = 8
+    if inbox is not None:
+        kw["inbox"] = inbox
     return MinPaxosConfig(**kw)
+
+
+def adaptive_capacity(hwm: int) -> int:
+    """Occupancy-derived inbox capacity: the measured delivered-rows
+    high-water mark (paxray TEL_INBOX_HWM) plus 25% headroom, rounded
+    up to 32 rows. Both the routing capacity (cfg.inbox) and the
+    compacted kernel inbox (cfg.compact_inbox) take this one number —
+    below it a point LOSES proposals, which the lossless check
+    rejects."""
+    return max(64, ((hwm + hwm // 4 + 8 + 31) // 32) * 32)
 
 
 def measure_point(protocol: str, g: int, w: int, p: int, k: int,
                   dispatches: int = 3, key_space: int | None = None,
-                  shard_devices: int = 1, seed: int = 0) -> dict:
+                  shard_devices: int = 1, seed: int = 0,
+                  inbox: int | None = None, compact: int = 0) -> dict:
     """Time the resident loop at one (g, w, p, k) point: warm one
     dispatch, run ``dispatches`` back-to-back (two-scalar readbacks
     only), then drain and REQUIRE exactness (in-flight == 0) — a point
-    that cannot drain is not a legal operating point, however fast."""
-    cfg = point_config(protocol, w, p)
+    that cannot drain is not a legal operating point, however fast.
+
+    The paxray telemetry ring rides every point; the post-window
+    readback (the sanctioned once-after-the-measured-window path)
+    yields the point's delivered-occupancy high-water mark
+    (``occupancy_hwm``), which seeds the adaptive-capacity axis —
+    ``inbox``/``compact`` override the default capacity with an
+    occupancy-derived one. ``lossless`` pins that no proposal was
+    dropped (total commits == total injected; minpaxos/classic only —
+    Mencius frontiers count SKIP no-op slots, so drained_exact is its
+    contract)."""
+    cfg = point_config(protocol, w, p, inbox=inbox, compact=compact)
     if key_space is None:
         key_space = cpu_key_space(p)
     mesh = None
@@ -95,7 +121,9 @@ def measure_point(protocol: str, g: int, w: int, p: int, k: int,
                         key_space=key_space, seed=seed)
     if protocol != "mencius":
         sc.elect(0)
-    sc.begin_resident()
+    # ring sized for every round the point can run (warm + baseline +
+    # measured + drain) so the readback never wraps
+    sc.begin_resident(telemetry_rounds=(2 + dispatches + 8) * k)
     sc.run_resident(k, p)  # warm/compile
     compile_s = time.perf_counter() - t_build
     c0, _ = sc.run_resident(k, p)
@@ -106,20 +134,42 @@ def measure_point(protocol: str, g: int, w: int, p: int, k: int,
     wall = time.perf_counter() - t0
     measured = committed - c0  # commits inside the timed window only
     in_flight = None
+    total = committed
+    drain_dispatches = 0
     for _ in range(8):
-        _, in_flight = sc.run_resident(k, 0)
+        total, in_flight = sc.run_resident(k, 0)
+        drain_dispatches += 1
         if in_flight == 0:
             break
+    from minpaxos_tpu.obs.recorder import TEL_INBOX_HWM
+
+    tel = sc.resident_telemetry()
+    hwm = int(tel[:, TEL_INBOX_HWM].max()) if len(tel) else 0
     hist = sc.end_resident()
+    injected = (2 + dispatches) * k * p * g * (
+        cfg.n_replicas if protocol == "mencius" else 1)
     return {
         "protocol": protocol,
         "g": g, "w": w, "p": p, "k": k,
         "shard_devices": shard_devices,
         "catchup_rows": cfg.catchup_rows,
+        "inbox": cfg.inbox,
+        "compact_inbox": cfg.compact_inbox,
+        "adaptive": inbox is not None or compact > 0,
         "inst_per_sec": round(measured / wall, 1),
         "ms_per_round": round(wall / (dispatches * k) * 1e3, 3),
         "committed": int(measured),
+        "committed_total": int(total),
         "drained_exact": in_flight == 0,
+        "occupancy_hwm": hwm,
+        # every injected proposal committed. Points can fail this for a
+        # NON-capacity reason: deep-pipeline shapes (w = 4p) bounce a
+        # slice of proposals off the full window at ANY capacity — the
+        # PR-8/9 grid always had that; only capacity-ATTRIBUTABLE loss
+        # (adaptive total < the same point's base total) disqualifies,
+        # see _legal
+        "lossless": (None if protocol == "mencius"
+                     else int(total) == injected),
         "latency_samples": int(hist.sum()),
         "compile_s": round(compile_s, 1),
     }
@@ -140,33 +190,83 @@ def default_grid(protocol: str, device_count: int) -> list[tuple]:
     return pts
 
 
-SMOKE_POINTS = [(1, 128, 16, 2, 1), (2, 128, 16, 2, 1)]
+SMOKE_POINT = (1, 128, 16, 2, 1)  # base; the 2nd smoke point derives
+# its capacity from this one's measured occupancy (same 2-compile
+# budget as the original fixed pair — no new compiled gate variant)
+
+
+def _legal(r: dict) -> bool:
+    """A crownable point: drains exactly, no error — and an ADAPTIVE
+    point must not have lost proposals to its capacity choice: either
+    absolutely lossless, or (deep-pipeline shapes that bounce
+    proposals off the full window at any capacity) committing exactly
+    what its own base-capacity run committed (``lossless_vs_base``,
+    stamped by the sweep). Base points keep the PR-8/9 bar."""
+    if not (bool(r.get("drained_exact")) and not r.get("error")):
+        return False
+    if not r.get("adaptive"):
+        return True
+    return bool(r.get("lossless")) or bool(r.get("lossless_vs_base"))
 
 
 def sweep(protocol: str = "minpaxos", budget_s: float = 900.0,
           points: list[tuple] | None = None, dispatches: int = 3,
-          seed: int = 0) -> dict:
+          seed: int = 0, adaptive: bool = True) -> dict:
+    """Measure the grid, then — ``adaptive`` — re-measure the best
+    base point with its inbox capacity derived from the MEASURED
+    occupancy high-water mark (telemetry TEL_INBOX_HWM ->
+    ``adaptive_capacity``) and the kernel inbox compacted to the same
+    rows (cfg.compact_inbox). The swept axis the PR-11 tentpole adds:
+    branch-free kernels cost ∝ capacity, so occupancy-fit capacity is
+    a direct throughput lever; a lossy point (dropped proposals) is
+    rejected by ``_legal``."""
     t_start = time.perf_counter()
     grid = points if points is not None else default_grid(
         protocol, jax.device_count())
     results, dropped = [], []
+
+    def run_point(g, w, p, k, sd, inbox=None, compact=0, derived=None):
+        try:
+            rec = measure_point(protocol, g, w, p, k,
+                                dispatches=dispatches, shard_devices=sd,
+                                seed=seed, inbox=inbox, compact=compact)
+        except Exception as e:  # noqa: BLE001 — a too-big point must
+            # not kill the sweep; the failure is recorded, not hidden
+            rec = {"protocol": protocol, "g": g, "w": w, "p": p, "k": k,
+                   "shard_devices": sd, "error": repr(e)[:200]}
+        if derived is not None:
+            rec["derived_from_hwm"] = derived
+        results.append(rec)
+        print(f"[ladder] {rec}", file=sys.stderr, flush=True)
+        return rec
+
     for pt in grid:
         g, w, p, k, sd = pt
         if time.perf_counter() - t_start > budget_s and results:
             dropped.append(list(pt))
             continue
-        try:
-            rec = measure_point(protocol, g, w, p, k,
-                                dispatches=dispatches, shard_devices=sd,
-                                seed=seed)
-        except Exception as e:  # noqa: BLE001 — a too-big point must
-            # not kill the sweep; the failure is recorded, not hidden
-            rec = {"protocol": protocol, "g": g, "w": w, "p": p, "k": k,
-                   "shard_devices": sd, "error": repr(e)[:200]}
-        results.append(rec)
-        print(f"[ladder] {rec}", file=sys.stderr, flush=True)
-    legal = [r for r in results
-             if r.get("drained_exact") and not r.get("error")]
+        run_point(g, w, p, k, sd)
+    if adaptive:
+        base_legal = [r for r in results if _legal(r)
+                      and r.get("occupancy_hwm", 0) > 0]
+        if base_legal and time.perf_counter() - t_start <= budget_s:
+            best = max(base_legal, key=lambda r: r["inst_per_sec"])
+            cap = adaptive_capacity(best["occupancy_hwm"])
+            if cap < best["inbox"] + best["p"]:  # else nothing to gain
+                rec = run_point(best["g"], best["w"], best["p"],
+                                best["k"], best["shard_devices"],
+                                inbox=cap, compact=cap,
+                                derived=best["occupancy_hwm"])
+                # capacity-attributable loss check: same workload
+                # schedule as the base run, so equal committed totals
+                # mean the tighter capacity dropped nothing even on
+                # shapes that bounce proposals off the window
+                if rec.get("committed_total") == best.get(
+                        "committed_total"):
+                    rec["lossless_vs_base"] = True
+        elif base_legal:
+            dropped.append(["adaptive", "budget"])
+    legal = [r for r in results if _legal(r)]
     winner = max(legal, key=lambda r: r["inst_per_sec"]) if legal else None
     return {
         "protocol": protocol,
@@ -181,14 +281,40 @@ def sweep(protocol: str = "minpaxos", budget_s: float = 900.0,
 
 def smoke() -> int:
     """CI gate (tools/run_tier1.sh): two tiny points through the full
-    resident path — commits flow, the drain is exact, the latency
-    sample is complete, and g=2 agrees with g=1 per shard. Budget <=60s
-    after compile; asserts are the contract."""
+    resident path — a fixed base point, then a g=2 point whose inbox
+    capacity is DERIVED from the base point's measured occupancy
+    high-water mark with the kernel inbox compacted to it (the PR-11
+    adaptive-capacity path). Contract: commits flow, every point
+    drains exactly, the adaptive point is LOSSLESS (occupancy-fit
+    capacity dropped nothing), and the latency sample is complete.
+    Still exactly two compiled dispatch variants; budget <=60s after
+    compile."""
     t0 = time.perf_counter()
-    rec = sweep(points=SMOKE_POINTS, dispatches=2, budget_s=300.0)
-    wall = time.perf_counter() - t0
+    g, w, p, k, sd = SMOKE_POINT
+
+    def _point(*a, **kw):
+        # same containment contract as sweep()'s run_point: a point
+        # that throws becomes a FAIL-able error record, not a raw
+        # traceback that skips the gate's diagnostics
+        try:
+            return measure_point(*a, **kw)
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)[:200]}
+
+    points = [_point("minpaxos", g, w, p, k, dispatches=2,
+                     shard_devices=sd)]
+    base = points[0]
     ok = True
-    for r in rec["points"]:
+    if not base.get("error") and base.get("occupancy_hwm", 0) > 0:
+        cap = adaptive_capacity(base["occupancy_hwm"])
+        points.append(_point("minpaxos", 2, w, p, k, dispatches=2,
+                             shard_devices=sd, inbox=cap,
+                             compact=cap))
+    else:
+        print(f"FAIL: base point unusable (no occupancy readback): {base}")
+        ok = False
+    wall = time.perf_counter() - t0
+    for r in points:
         if r.get("error") or not r.get("drained_exact"):
             print(f"FAIL: ladder point did not drain exactly: {r}")
             ok = False
@@ -196,16 +322,27 @@ def smoke() -> int:
         if r["committed"] <= 0 or r["latency_samples"] <= 0:
             print(f"FAIL: ladder point made no progress: {r}")
             ok = False
-    if rec["winner"] is None:
+        if r.get("lossless") is False:
+            print(f"FAIL: point dropped proposals (capacity below "
+                  f"occupancy): {r}")
+            ok = False
+    winner = max([r for r in points if _legal(r)],
+                 key=lambda r: r["inst_per_sec"], default=None)
+    if winner is None:
         print("FAIL: no legal winner among smoke points")
         ok = False
-    post_compile = wall - sum(r.get("compile_s", 0) for r in rec["points"])
-    print(f"shape-ladder smoke: {len(rec['points'])} points, "
-          f"winner g={rec['winner']['g']} w={rec['winner']['w']} "
-          f"p={rec['winner']['p']} k={rec['winner']['k']} "
-          f"({rec['winner']['inst_per_sec']:.0f} inst/s), "
-          f"{wall:.1f}s wall ({post_compile:.1f}s post-compile)"
-          if ok else "shape-ladder smoke: FAILED")
+    post_compile = wall - sum(r.get("compile_s", 0) for r in points)
+    if ok:
+        adapt = points[1]
+        print(f"shape-ladder smoke: {len(points)} points, winner "
+              f"g={winner['g']} w={winner['w']} p={winner['p']} "
+              f"k={winner['k']} ({winner['inst_per_sec']:.0f} inst/s); "
+              f"adaptive point: hwm={base['occupancy_hwm']} -> "
+              f"inbox={adapt['inbox']} (compacted, was "
+              f"{base['inbox']}+{p} ext), lossless+drain-exact; "
+              f"{wall:.1f}s wall ({post_compile:.1f}s post-compile)")
+    else:
+        print("shape-ladder smoke: FAILED")
     return 0 if ok else 1
 
 
