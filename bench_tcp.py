@@ -87,20 +87,59 @@ RUNTIME_KNOBS = {
     # BENCH_TCP_RECORDER=0 runs -norecorder for the overhead A/B
     # (acceptance: p50 + closed-loop within 3% of disabled)
     "recorder": os.environ.get("BENCH_TCP_RECORDER", "1") != "0",
+    # paxtrace (default ON): sampled per-command stage spans; the
+    # throughput legs trace 1-in-2^BENCH_TCP_TRACEPOW2, the serial
+    # leg overrides to pow2=0 (every op traced — that IS the
+    # measurement). BENCH_TCP_TRACE=0 runs -notrace for the overhead
+    # A/B (tracing off is byte-transparent on the wire).
+    "trace": os.environ.get("BENCH_TCP_TRACE", "1") != "0",
+    "trace_pow2": os.environ.get("BENCH_TCP_TRACEPOW2", "4"),
 }
 
 
-def _knob_args(keyhint: int) -> list:
+def _knob_args(keyhint: int, trace_pow2: str | None = None) -> list:
     args = ["-fuseticks", RUNTIME_KNOBS["fuse_ticks"],
             "-narrow", RUNTIME_KNOBS["narrow_window"],
-            "-keyhint", str(keyhint)]
+            "-keyhint", str(keyhint),
+            "-tracepow2", trace_pow2 or RUNTIME_KNOBS["trace_pow2"]]
     if not RUNTIME_KNOBS["idle_fastpath"]:
         args.append("-noidlefast")
     if not RUNTIME_KNOBS["pipeline"]:
         args.append("-nopipeline")
     if not RUNTIME_KNOBS["recorder"]:
         args.append("-norecorder")
+    if not RUNTIME_KNOBS["trace"]:
+        args.append("-notrace")
     return args
+
+
+def _client_trace_pow2(serial: bool = False) -> int | None:
+    """Client-side sampling exponent matching the cluster's knobs
+    (sampling is deterministic on cmd_id, so both sides must use the
+    same exponent to see the same commands)."""
+    if not RUNTIME_KNOBS["trace"]:
+        return None
+    return 0 if serial else int(RUNTIME_KNOBS["trace_pow2"])
+
+
+def _traced_latency(maddr, client_colls: list[dict]) -> dict:
+    """The paxtrace record for one leg: cluster TRACESPANS fan-out +
+    the driver's own span collections -> full traced latency
+    distribution (p50/p90/p99/p999) and the per-stage decomposition
+    table (obs/trace.py), embedded in the artifact so the tail story
+    is attributable without rerunning the bench."""
+    try:
+        from minpaxos_tpu.obs.trace import analyze_collections
+        from minpaxos_tpu.runtime.master import cluster_tracespans
+
+        resp = cluster_tracespans(maddr)
+        colls = [r["trace"] for r in resp.get("replicas", [])
+                 if r.get("ok") and isinstance(r.get("trace"), dict)]
+        colls += [c for c in client_colls if c]
+        table, _, _ = analyze_collections(colls)
+        return table
+    except Exception as e:  # noqa: BLE001 — obs must not fail a bench
+        return {"error": repr(e)[:200]}
 
 
 def _progress(msg: str) -> None:
@@ -144,19 +183,22 @@ import contextlib
 
 
 @contextlib.contextmanager
-def _cluster(proto_flag: str, shape, keyhint: int = 100000):
+def _cluster(proto_flag: str, shape, keyhint: int = 100000,
+             trace_pow2: str | None = None):
     """Boot master + 3 servers with a fresh store dir; yield the master
     address; tear everything down (SIGTERM, then kill) and wipe the
     stores on exit — the one copy of the lifecycle both the throughput
     and serial legs use. ``keyhint``: the workload's distinct-key
-    count, forwarded so servers log projected KV load at boot."""
+    count, forwarded so servers log projected KV load at boot.
+    ``trace_pow2`` overrides the paxtrace sampling knob (the serial
+    leg traces every command)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
     tmp = REPO / ".bench_tcp_store"
     tmp.mkdir(exist_ok=True)
     for f in tmp.glob("stable-store-replica*"):
         f.unlink()
     procs, mport = _boot(proto_flag, env, tmp,
-                         list(shape) + _knob_args(keyhint))
+                         list(shape) + _knob_args(keyhint, trace_pow2))
     try:
         yield ("127.0.0.1", mport)
     finally:
@@ -232,12 +274,16 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
         _progress(f"{label}: warm; {k} throughput trials of q={q}")
 
         ops, keys, vals = gen_workload(q, seed=42)
+        tp2 = _client_trace_pow2()
         rates, trial_stats = [], []
+        traced = {}
         for t in range(k):
             # fresh connection per trial: fresh reply book, fresh
             # server-side pending set, no cross-trial cmd_id reuse
-            drv = (MultiClient(maddr, check=True, mode="rr")
-                   if multi_rr else Client(maddr, check=True))
+            drv = (MultiClient(maddr, check=True, mode="rr",
+                               trace_pow2=tp2)
+                   if multi_rr else Client(maddr, check=True,
+                                           trace_pow2=tp2))
             try:
                 t0 = time.perf_counter()
                 # batch 512 on purpose: 1024 (== SERVER_SHAPE's inbox)
@@ -249,6 +295,13 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
                 stats = drv.run_workload(ops, keys, vals, timeout_s=120,
                                          batch=512)
                 wall = time.perf_counter() - t0
+                if t == k - 1 and tp2 is not None:
+                    # span collection for the LAST trial only: rings
+                    # keep newest spans, and cross-trial cmd_id reuse
+                    # makes per-trial collection the honest window
+                    colls = (drv.trace_collect() if multi_rr else
+                             [drv.trace_collect()])
+                    traced = _traced_latency(maddr, colls)
             finally:
                 try:
                     drv.close() if multi_rr else drv.close_conn()
@@ -281,6 +334,11 @@ def run_config(proto_flag: str, label: str, ref_shape: str,
             "runtime_knobs": dict(RUNTIME_KNOBS),
             "reference_shape": ref_shape,
             "metrics_snapshot": metrics_snap,
+            # full traced latency distribution (p50/p90/p99/p999 +
+            # per-stage decomposition) for the last -check trial —
+            # the ISSUE-12 satellite: the artifact carries the whole
+            # distribution, not just scalar percentiles
+            "traced_latency": traced,
         }
 
 
@@ -289,13 +347,18 @@ def run_serial(proto_flag: str, label: str) -> dict:
     one-at-a-time ops with UNIQUE cmd_ids (clientlat shape,
     clientlat/client.go:134-160), failover-robust (a rejection or dead
     socket re-routes instead of crashing the record)."""
-    with _cluster(proto_flag, SERIAL_SHAPE, keyhint=520) as maddr:
+    tp2 = _client_trace_pow2(serial=True)
+    with _cluster(proto_flag, SERIAL_SHAPE, keyhint=520,
+                  trace_pow2="0" if tp2 is not None else None) as maddr:
         from minpaxos_tpu.cli.client import _propose_until_acked
         from minpaxos_tpu.runtime.client import Client
 
         _progress(f"{label}: serial cluster booting")
         _warm(maddr)
-        cli = Client(maddr, check=True)
+        # the serial leg traces EVERY op (pow2=0): 200 one-at-a-time
+        # commands is exactly the sample the tail story needs, and the
+        # per-op tracing cost is bounded by the obs_smoke guard
+        cli = Client(maddr, check=True, trace_pow2=tp2)
         cli.connect()
         lats = []
         for i in range(200):
@@ -305,14 +368,26 @@ def run_serial(proto_flag: str, label: str) -> dict:
                                     np.asarray([7000 + i]),
                                     np.asarray([i]), timeout_s=10.0):
                 lats.append((time.perf_counter() - t1) * 1e3)
+        traced = ({} if tp2 is None else
+                  _traced_latency(maddr, [cli.trace_collect()]))
         cli.close_conn()
         metrics_snap = _metrics_snapshot(maddr)
         lats.sort()
+
+        def _pct(q):
+            return (round(lats[min(int(len(lats) * q), len(lats) - 1)], 3)
+                    if lats else None)
+
         return {
-            "serial_p50_ms": round(lats[len(lats) // 2], 3)
-            if lats else None,
-            "serial_p99_ms": round(lats[int(len(lats) * 0.99)], 3)
-            if lats else None,
+            "serial_p50_ms": _pct(0.50),
+            "serial_p99_ms": _pct(0.99),
+            # the full client-measured distribution (not just two
+            # scalars) + the paxtrace stage decomposition of the same
+            # ops — "p99 is X ms" and WHERE those ms went, in one record
+            "serial_latency": {"p50_ms": _pct(0.50), "p90_ms": _pct(0.90),
+                               "p99_ms": _pct(0.99), "p999_ms": _pct(0.999),
+                               "max_ms": _pct(1.0)},
+            "serial_traced": traced,
             "n_serial": len(lats),
             "serial_shape": " ".join(SERIAL_SHAPE),
             "runtime_knobs": dict(RUNTIME_KNOBS),

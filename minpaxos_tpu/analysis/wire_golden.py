@@ -48,6 +48,7 @@ GOLDEN_KINDS: dict[str, tuple[int, int | None]] = {
     "PREPARE_INST": (24, 10),
     "PREPARE_INST_REPLY": (25, 39),
     "SKIP": (28, 9),
+    "TRACE_CTX": (32, 20),
     "HANDSHAKE_CLIENT": (120, None),
     "HANDSHAKE_PEER": (121, None),
 }

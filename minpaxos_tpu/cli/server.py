@@ -111,6 +111,19 @@ def main(argv=None) -> None:
                         " per-tick ring served by the control socket's"
                         " TRACE verb; see OBSERVABILITY.md) — for"
                         " overhead A/Bs; the metrics registry stays on")
+    p.add_argument("-notrace", action="store_true",
+                   help="disable paxtrace sampled per-command tracing"
+                        " (the span rings served by the control"
+                        " socket's TRACESPANS verb; OBSERVABILITY.md)"
+                        " — for overhead A/Bs; disabled tracing is"
+                        " byte-transparent on the wire")
+    p.add_argument("-tracepow2", type=int, default=4,
+                   help="paxtrace sampling exponent: 1 command in"
+                        " 2^k is traced (0 = every command — the"
+                        " serial-latency bench setting)")
+    p.add_argument("-tracering", type=int, default=4096,
+                   help="paxtrace span-ring capacity per writer"
+                        " thread (5 int64 fields per span)")
     p.add_argument("-recring", type=int, default=4096,
                    help="flight-recorder ring capacity in ticks"
                         " (12 int64 fields per row: 4096 ≈ 384 KiB)")
@@ -183,6 +196,9 @@ def main(argv=None) -> None:
                          warm_variants=True,
                          recorder=not args.norecorder,
                          recorder_ring=args.recring,
+                         trace=not args.notrace,
+                         trace_pow2=args.tracepow2,
+                         trace_ring=args.tracering,
                          profile=prof)
     server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags,
                            protocol=protocol)

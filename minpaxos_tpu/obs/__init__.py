@@ -37,6 +37,7 @@ from minpaxos_tpu.obs.metrics import (
 )
 from minpaxos_tpu.obs.recorder import (
     DEVICE_PID,
+    TRACE_PID,
     FlightRecorder,
     KIND_FULL,
     KIND_FUSED,
@@ -51,12 +52,33 @@ from minpaxos_tpu.obs.recorder import (
     telemetry_valid_rows,
     validate_chrome_trace,
 )
+from minpaxos_tpu.obs.trace import (
+    DECOMP_STAGES,
+    STAGE_NAMES,
+    SpanRing,
+    TraceSink,
+    align_collections,
+    analyze_collections,
+    format_stage_table,
+    is_sampled,
+    sampled_mask,
+    span_chains,
+    span_events,
+    stage_decomposition,
+    stage_table,
+    trace_id_for,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "TICK_MS_BUCKETS", "FlightRecorder", "KIND_FULL", "KIND_FUSED",
     "KIND_NARROW", "KIND_IDLE_SKIP", "KIND_NAMES", "SCHEMA_VERSION",
-    "DEVICE_PID", "N_TEL_FIELDS", "TEL_FIELD_NAMES",
+    "DEVICE_PID", "TRACE_PID", "N_TEL_FIELDS", "TEL_FIELD_NAMES",
     "chrome_trace", "device_round_events", "telemetry_valid_rows",
     "validate_chrome_trace",
+    "DECOMP_STAGES", "STAGE_NAMES", "SpanRing", "TraceSink",
+    "align_collections", "analyze_collections", "format_stage_table",
+    "is_sampled",
+    "sampled_mask", "span_chains", "span_events",
+    "stage_decomposition", "stage_table", "trace_id_for",
 ]

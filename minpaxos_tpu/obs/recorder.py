@@ -46,8 +46,12 @@ import numpy as np
 #: telemetry readback rendered as round slices + counter tracks under
 #: the reserved DEVICE_PID, mergeable with host flight-recorder events
 #: into one validated timeline. The tick-row layout itself is
-#: unchanged from v3.)
-SCHEMA_VERSION = 4
+#: unchanged from v3. v5: paxtrace per-command span tracks
+#: (obs/trace.py) — stage slices for sampled commands under the
+#: reserved TRACE_PID, so one merged file shows a command's client ->
+#: replica -> device-rounds -> reply chain next to the tick and
+#: device-round tracks. Tick-row layout again unchanged.)
+SCHEMA_VERSION = 5
 
 # dispatch regimes (runtime/replica.py classifies one per tick:
 # narrow > fused > full; idle-skip never reaches the device)
@@ -97,7 +101,13 @@ _EVENT_PHASES = frozenset("XBEiICMsnbe")  # trace-event ph codes we accept
 #: flight-recorder events use replica-id pids (small ints); the
 #: validator enforces that ``device_round`` events carry exactly this
 #: pid so a merged file keeps one unambiguous device track group.
+#: (obs/trace.py reserves the sibling TRACE_PID = 9998 for paxtrace
+#: command-span tracks; the validator pins that one too.)
 DEVICE_PID = 9999
+
+#: schema v5: reserved pid for paxtrace per-command span tracks
+#: (obs/trace.py emits them; it imports this constant)
+TRACE_PID = 9998
 
 # telemetry-row field layout (glossary in OBSERVABILITY.md):
 # round — absolute protocol round index (-1 = row never written);
@@ -377,4 +387,20 @@ def validate_chrome_trace(trace) -> list[str]:
         if not is_device and ev.get("pid") == DEVICE_PID:
             errs.append(f"{where}: pid {DEVICE_PID} is reserved for "
                         f"device-round tracks")
+        # schema v5: paxtrace command-span tracks live on TRACE_PID and
+        # nothing else may squat there — and every span must carry its
+        # trace id so a viewer selection can be joined back to spans
+        is_span = ev.get("cat") == "paxtrace"
+        if is_span:
+            if ev.get("pid") != TRACE_PID:
+                errs.append(f"{where}: paxtrace event must carry the "
+                            f"reserved pid {TRACE_PID}, got "
+                            f"{ev.get('pid')!r}")
+            args = ev.get("args")
+            if not isinstance(args, dict) or "trace_id" not in args:
+                errs.append(f"{where}: paxtrace event needs "
+                            f"args.trace_id")
+        elif ev.get("pid") == TRACE_PID:
+            errs.append(f"{where}: pid {TRACE_PID} is reserved for "
+                        f"paxtrace command-span tracks")
     return errs

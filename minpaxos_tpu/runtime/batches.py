@@ -137,8 +137,10 @@ def frame_to_rows(buf: ColumnBuffer, kind: MsgKind, rows: np.ndarray,
         buf.append(n, kind=k, src=rows["leader_id"].astype(np.int32),
                    inst=rows["end_inst"],
                    last_committed=rows["start_inst"])
-    # READ / BEACON / handshake kinds are handled on the host path
-    # (transport/replica), never as device rows.
+    # READ / BEACON / TRACE_CTX / handshake kinds are handled on the
+    # host path (transport/replica), never as device rows — a
+    # TRACE_CTX frame reaching here (tracing toggled off mid-stream)
+    # is deliberately a no-op, not an error.
 
 
 def rows_to_frames(cols: dict, mask: np.ndarray) -> list[tuple[MsgKind, np.ndarray]]:

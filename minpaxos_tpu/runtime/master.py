@@ -104,7 +104,7 @@ class Master:
 
     def _handle(self, req: dict) -> dict:
         m = req.get("m")
-        if m in ("stats", "trace", "chaos"):
+        if m in ("stats", "trace", "chaos", "tracespans"):
             # paxmon/paxchaos fan-out verbs: these poll every replica's
             # control socket, so they must NOT run under the membership
             # lock — one slow replica's 2 s control timeout would stall
@@ -154,14 +154,14 @@ class Master:
             nodes = list(enumerate(self.nodes))
             leader = self.leader
             alive = list(self.alive)
-        if m == "stats":
+        if m in ("stats", "tracespans"):
             sub = {"m": m}
         elif m == "trace":
             sub = {"m": "trace", "last": req.get("last")}
         else:
             sub = {"m": "chaos", "op": req.get("op", "status"),
                    "plan": req.get("plan")}
-        timeout = 5.0 if m == "trace" else 2.0
+        timeout = 5.0 if m in ("trace", "tracespans") else 2.0
         # one poller thread per replica: dead replicas cost
         # max(timeout), not sum — a mostly-down cluster must still
         # answer inside the caller's own socket timeout
@@ -369,6 +369,15 @@ def cluster_chaos(maddr: tuple[str, int], op: str = "status",
     the cluster faulted behind a 'healed' campaign."""
     return _rpc(maddr, {"m": "chaos", "op": op, "plan": plan},
                 timeout=timeout_s)
+
+
+def cluster_tracespans(maddr: tuple[str, int],
+                       timeout_s: float = 60.0) -> dict:
+    """paxtrace fan-out: every replica's span-ring collection (plus its
+    monotonic<->wall clock anchor) in one RPC — the raw material
+    ``tools/tail.py`` and the bench artifacts turn into a per-stage
+    latency decomposition (obs/trace.py)."""
+    return _rpc(maddr, {"m": "tracespans"}, timeout=timeout_s)
 
 
 def cluster_trace(maddr: tuple[str, int], last: int | None = None,

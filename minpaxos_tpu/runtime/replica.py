@@ -20,6 +20,7 @@ touched only by the protocol thread — the reference's benign races
 from __future__ import annotations
 
 import functools
+import heapq
 import json
 import queue
 import socket
@@ -49,6 +50,15 @@ from minpaxos_tpu.obs.recorder import (
     KIND_IDLE_SKIP,
     KIND_NARROW,
     FlightRecorder,
+)
+from minpaxos_tpu.obs.trace import (
+    ST_COMMIT,
+    ST_DRAIN,
+    ST_EXEC,
+    ST_ORIGIN,
+    ST_REPLY_SER,
+    TraceSink,
+    trace_id_for,
 )
 from minpaxos_tpu.ops.kvstore import LIVE
 from minpaxos_tpu.ops.packed import join_i64, split_i64
@@ -235,6 +245,16 @@ class RuntimeFlags:
     # tools/obs_smoke.py pins it); -norecorder disables for A/Bs.
     recorder: bool = True
     recorder_ring: int = 4096
+    # paxtrace (obs/trace.py): sampled per-command stage spans served
+    # over the control socket's TRACESPANS verb. Default ON at the
+    # 1-in-2^trace_pow2 sample rate — unsampled commands pay one
+    # vectorized hash per batch, sampled ones a handful of ring writes
+    # (the obs_smoke per-command overhead guard pins the budget);
+    # -notrace disables for A/Bs, trace_pow2=0 traces every command
+    # (the serial-latency bench leg).
+    trace: bool = True
+    trace_pow2: int = 4
+    trace_ring: int = 4096
     store_dir: str = "."
     # -cpuprofile: a cProfile.Profile the PROTOCOL THREAD enables on
     # start (cProfile is per-thread; enabling it on the main thread —
@@ -316,10 +336,26 @@ class ReplicaServer:
             "host phases does not appear here)", TICK_MS_BUCKETS)
         self.recorder = (FlightRecorder(self.flags.recorder_ring)
                          if self.flags.recorder else None)
+        # paxtrace sink: one per replica, shared with the transport's
+        # reader threads (each thread gets its own ring inside). The
+        # sink exists even when disabled so every touch point stays
+        # one `.enabled` test.
+        self.trace_sink = TraceSink(enabled=self.flags.trace,
+                                    sample_pow2=self.flags.trace_pow2,
+                                    ring_capacity=self.flags.trace_ring)
+        m.fn_gauge("trace_spans", self.trace_sink.spans_total)
+        m.fn_gauge("trace_dropped", self.trace_sink.spans_dropped)
+        # sampled in-flight bookkeeping (protocol thread only): a
+        # min-heap of (log slot, cmd_id) awaiting commit stamps
+        # (bounded by the sampled in-flight count, 1-in-2^k of the
+        # window; heap so the per-dispatch pop is O(covered), never a
+        # scan of everything still above the frontier)
+        self._trace_slots: list[tuple[int, int]] = []
         self._drain_wait_s = 0.0  # blocking queue wait (idle pacing)
         self._drain_work_s = 0.0  # frame-decode/dedup work in _drain
         self._last_scals = None  # newest published scalar vector
         self.transport = Transport(me, addrs, metrics=self.metrics)
+        self.transport.trace = self.trace_sink
         self.queue = self.transport.queue
         # the MODULE-level jitted packed step (static cfg + impl):
         # every replica in the process shares ONE compile cache — N
@@ -617,6 +653,14 @@ class ReplicaServer:
                     resp = {"ok": True, "id": self.me,
                             "recorder": self.recorder is not None,
                             "events": events}
+                elif m == "tracespans":
+                    # paxtrace collection: every span ring of this
+                    # process (protocol thread, transport readers) plus
+                    # the monotonic<->wall clock anchor tail.py aligns
+                    # processes by. The copy is taken under the sink's
+                    # tiny locks; the writers never block.
+                    resp = {"ok": True, "id": self.me,
+                            "trace": self.trace_sink.collect()}
                 elif m == "chaos":
                     # paxchaos verb: install/clear/status a fault plan
                     # on the LIVE transport. Installing is an attribute
@@ -886,6 +930,30 @@ class ReplicaServer:
                     client_id=conn_id)
                 for c in rows["cmd_id"]:
                     self._pending[(conn_id, int(c))] = MsgKind.READ_REPLY
+            elif kind == MsgKind.TRACE_CTX:
+                # paxtrace context (host-path verb, never a device
+                # row): echo the client's origin timestamp as the
+                # chain's start span, RE-STAMPED into this replica's
+                # monotonic domain (wall minus OUR wall-mono offset —
+                # an exact identity when client and replica share a
+                # host, the honest correction when they don't).
+                # Filtered through OUR sampling exponent: a client
+                # tracing more aggressively than the cluster must
+                # degrade to the intersection, not flood the protocol
+                # thread's ring with ORIGIN rows whose chains can
+                # never complete.
+                if self.trace_sink.enabled and len(rows):
+                    m = self.trace_sink.sampled(rows["cmd_id"])
+                    if m.any():
+                        ring = self.trace_sink.ring()
+                        my_off = time.time_ns() - monotonic_ns()
+                        take = rows[m]
+                        for cmd, tid, wall in zip(
+                                take["cmd_id"].tolist(),
+                                take["trace_id"].tolist(),
+                                take["origin_wall_ns"].tolist()):
+                            ring.record(tid, ST_ORIGIN, wall - my_off,
+                                        wall - my_off, cmd)
             else:
                 if src_kind == FROM_PEER and kind in (
                         MsgKind.PREPARE, MsgKind.ACCEPT, MsgKind.COMMIT,
@@ -920,6 +988,15 @@ class ReplicaServer:
                     for c in rows["cmd_id"]:
                         self._pending[(conn_id, int(c))] = MsgKind.PROPOSE_REPLY
                     self._c_proposals.inc(len(rows))
+                    if self.trace_sink.enabled and len(rows):
+                        # drain stamp for sampled commands; aux = the
+                        # dispatch counter, so tail.py can say how many
+                        # device rounds admission -> execution took
+                        # (the flight-recorder row correlation)
+                        t_dr = monotonic_ns()
+                        self.trace_sink.stamp_batch(
+                            ST_DRAIN, rows["cmd_id"], t_dr, t_dr,
+                            aux=self._c_dispatches.value)
                     if DLOG:
                         dlog(f"replica {self.me}: drain PROPOSE "
                              f"n={len(rows)}")
@@ -1282,6 +1359,8 @@ class ReplicaServer:
         cols, n_rows, k = rec.cols, rec.n_rows, rec.k
         out_mats, exec_mats, scals = rec.out_mats, rec.exec_mats, rec.scals
         ncols = len(batches.COLS)
+        if self.trace_sink.enabled:
+            self._trace_commits(rec)
         persist_s = dispatch_s = reply_s = 0.0
         if rec.persist:
             # always maintained (in-memory mirror feeds beyond-window
@@ -1346,6 +1425,58 @@ class ReplicaServer:
                 int(persist_s * 1e6), int(dispatch_s * 1e6),
                 int(reply_s * 1e6), rec.t_rb_ns,
                 chaos_faults=self.transport.chaos_faults_total())
+
+    # -- paxtrace: slot assignment + commit stamps (protocol thread) --
+
+    def _trace_commits(self, rec: _InflightTick) -> None:
+        """Two paxtrace duties per dispatch, both O(sampled):
+
+        1. learn the log slot of every SAMPLED proposal this tick
+           admitted — the kernel's ACCEPT broadcast at outbox row i
+           carries the slot it assigned to inbox PROPOSE row i (the
+           same row alignment ``_persist`` relies on);
+        2. stamp ST_COMMIT for tracked slots the tick's frontier just
+           covered, at the tick's readback time (``t_rb_ns`` — the
+           moment the host LEARNED the commit; the device rounds in
+           between are the span).
+
+        The tracked set is a min-heap keyed on slot, NOT a dict: slots
+        can sit above the contiguous frontier for many dispatches
+        (out-of-order exec, re-proposals), and a full per-dispatch
+        scan of every tracked slot is protocol-thread time the
+        blocking-frontier protocols cannot spare under load.
+        """
+        sink = self.trace_sink
+        n = rec.n_rows
+        if n:
+            ik = rec.cols["kind"][:n]
+            pm = ik == int(MsgKind.PROPOSE)
+            if pm.any():
+                ids = rec.cols["cmd_id"][:n]
+                out_kind = rec.out_mats[0, 0, :n]  # col 0 = kind
+                sm = pm & sink.sampled(ids) \
+                    & (out_kind == int(MsgKind.ACCEPT))
+                if sm.any():
+                    out_inst = rec.out_mats[0, 3, :n]  # col 3 = inst
+                    ccol = rec.cols["client_id"][:n]
+                    for i in np.nonzero(sm)[0]:
+                        # linearizable READs ride the log as PROPOSE
+                        # rows too — their chains never complete (no
+                        # drain/exec spans by design), so a commit
+                        # stamp would only churn the ring
+                        if self._pending.get(
+                                (int(ccol[i]), int(ids[i]))) \
+                                == MsgKind.READ_REPLY:
+                            continue
+                        heapq.heappush(self._trace_slots,
+                                       (int(out_inst[i]), int(ids[i])))
+        if self._trace_slots and self._trace_slots[0][0] <= rec.frontier:
+            ring = sink.ring()
+            while self._trace_slots and \
+                    self._trace_slots[0][0] <= rec.frontier:
+                s, cmd = heapq.heappop(self._trace_slots)
+                ring.record(trace_id_for(cmd), ST_COMMIT,
+                            rec.t_rb_ns, rec.t_rb_ns, s)
 
     # -- durability: reconstruct accepted slots from (inbox, outbox) --
 
@@ -1549,11 +1680,25 @@ class ReplicaServer:
         # at bench load. No-op fills (cid < 0) are dropped vectorized.
         writes: dict[int, tuple[list, list]] = {}
         reads: dict[int, tuple[list, list]] = {}
+        sink = self.trace_sink
+        tracing = sink.enabled
+        traced: list[int] = []
+        t_x0 = monotonic_ns() if tracing else 0
+        # ONE vectorized sampling hash for the whole pass (the drain-
+        # path discipline): a scalar per-command hash here measured
+        # ~18x slower per 512-command batch, paid on the protocol
+        # thread for every write regardless of sample rate
+        smask = sink.sampled(cmds) if tracing else None
         for i in np.nonzero(cids >= 0)[0]:
             key = (int(cids[i]), int(cmds[i]))
             want = self._pending.pop(key, None)
             if want is None:
                 continue  # not proposed on this conn (or already replied)
+            if tracing and want != MsgKind.READ_REPLY and smask[i]:
+                # writes only: reads never get DRAIN/COMMIT spans, so
+                # an exec/reply stamp for them could never complete a
+                # chain — it would just churn the fixed rings
+                traced.append(key[1])
             book = reads if want == MsgKind.READ_REPLY else writes
             cs_, vs_ = book.setdefault(key[0], ([], []))
             cs_.append(key[1])
@@ -1570,6 +1715,23 @@ class ReplicaServer:
                                cmd_id=np.asarray(cs_, np.int32),
                                val=np.asarray(vs_, np.int64))
             self.transport.send_client(conn, MsgKind.READ_REPLY, frame)
+        if traced:
+            # one exec stamp (when the reply pass picked the command
+            # up — commit -> here is the exec-backlog wait; aux = the
+            # dispatch count, closing the drain-aux round correlation)
+            # and one reply-serialization span per sampled command.
+            # The span ends at ``ts`` — taken BEFORE the send loop: a
+            # same-host client can receive a frame before this code
+            # runs again, and a reply_ser end stamped after the sends
+            # would put reply_recv BEFORE it (negative transport_out,
+            # chain dropped as impossible under exactly the load the
+            # tail table exists to explain).
+            ring = sink.ring()
+            disp = self._c_dispatches.value
+            for cmd in traced:
+                tid = trace_id_for(cmd)
+                ring.record(tid, ST_EXEC, t_x0, t_x0, disp)
+                ring.record(tid, ST_REPLY_SER, t_x0, ts, cmd)
 
     # -- beyond-window catch-up from the durable log --
 

@@ -23,6 +23,8 @@ import time
 
 import numpy as np
 
+from minpaxos_tpu.obs.trace import ST_DECODE
+from minpaxos_tpu.utils.clock import monotonic_ns
 from minpaxos_tpu.utils.dlog import dlog
 from minpaxos_tpu.wire.codec import FrameWriter, StreamDecoder
 from minpaxos_tpu.wire.messages import MsgKind
@@ -75,6 +77,12 @@ class Transport:
         # install/heal cycles (same contract as _closed_tallies).
         self.chaos = None
         self._chaos_retired = 0
+        # paxtrace sink (obs/trace.py): when installed, reader threads
+        # stamp a frame-decode span for client PROPOSE frames carrying
+        # a SAMPLED command. Same discipline as the chaos shim: the
+        # disabled path is one attribute load + is-None test per chunk,
+        # and each reader thread writes only its OWN span ring.
+        self.trace = None
         # per-peer dial suppression state: a refused dial doubles the
         # peer's suppression window instead of re-timing out every
         # 0.5 s — a flapping or partitioned peer must not price a
@@ -333,6 +341,13 @@ class Transport:
                 break
             if not chunk:
                 break
+            # paxtrace ingress stamp: the decode span's t0 must cover
+            # the frame parse, so the timestamp is taken before feed —
+            # but only when a sink is installed AND enabled (disabled:
+            # one attr load + test per chunk, no clock read)
+            tr = self.trace
+            t_dec0 = (monotonic_ns() if tr is not None and tr.enabled
+                      and src_kind == FROM_CLIENT else 0)
             try:
                 frames = dec.feed(chunk)
             except ValueError:
@@ -341,6 +356,11 @@ class Transport:
             conn.frames_in += len(frames)
             for kind, rows in frames:
                 conn.rows_in += len(rows)
+                if t_dec0 and kind == MsgKind.PROPOSE:
+                    # one vectorized hash per propose frame; spans only
+                    # for sampled commands (this reader thread's ring)
+                    tr.stamp_batch(ST_DECODE, rows["cmd_id"], t_dec0,
+                                   monotonic_ns())
                 # paxchaos inbound gate, peer links only: the disabled
                 # path is one attribute load + is-test per frame
                 ch = self.chaos

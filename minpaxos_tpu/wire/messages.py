@@ -81,6 +81,14 @@ class MsgKind(enum.IntEnum):
     # mencius extras (reference menciusproto.go:7-51)
     SKIP = 28
 
+    # paxtrace context (obs/trace.py, no reference counterpart):
+    # client -> replica, written immediately BEFORE the PROPOSE frame
+    # carrying the sampled command on the same stream. Tracing
+    # disabled sends nothing, so the extension is byte-transparent to
+    # v1 peers; a v2 replica handles v1 streams (no ctx frame) by
+    # deriving the trace id from the command id alone.
+    TRACE_CTX = 32
+
     # connection handshake pseudo-kinds (reference genericsmrproto.go:16-17)
     HANDSHAKE_CLIENT = 120
     HANDSHAKE_PEER = 121
@@ -166,6 +174,17 @@ SCHEMAS: dict[MsgKind, np.dtype] = {
     # menciusproto.go:7-11.
     MsgKind.SKIP: np.dtype(
         [("leader_id", "i1"), ("start_inst", "<i4"), ("end_inst", "<i4")]),
+    # paxtrace context: trace id + the client's origin timestamp as
+    # WALL-clock ns (the cross-host bridge: the replica re-stamps the
+    # origin into its own monotonic domain by subtracting its
+    # wall-minus-mono offset — an identity when client and replica
+    # share a host, the honest correction when they don't; the
+    # client's own monotonic SEND span lives in its local ring, so a
+    # monotonic origin has no wire consumer). One row per sampled
+    # command.
+    MsgKind.TRACE_CTX: np.dtype(
+        [("cmd_id", "<i4"), ("trace_id", "<i8"),
+         ("origin_wall_ns", "<i8")]),
 }
 
 

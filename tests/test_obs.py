@@ -156,10 +156,10 @@ def test_trace_schema_version_stamped_and_checked():
     from minpaxos_tpu.obs.recorder import SCHEMA_VERSION
 
     tr = chrome_trace([])
-    assert tr["otherData"]["paxmonSchemaVersion"] == SCHEMA_VERSION == 4
+    assert tr["otherData"]["paxmonSchemaVersion"] == SCHEMA_VERSION == 5
     assert validate_chrome_trace(tr) == []
     stale = chrome_trace([])
-    stale["otherData"]["paxmonSchemaVersion"] = 3
+    stale["otherData"]["paxmonSchemaVersion"] = 4
     errs = validate_chrome_trace(stale)
     assert errs and "schema version mismatch" in errs[0]
     # traces without the stamp (e.g. hand-built fixtures) still pass

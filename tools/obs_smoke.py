@@ -55,7 +55,21 @@ from minpaxos_tpu.obs.metrics import MetricsRegistry  # noqa: E402
 from minpaxos_tpu.obs.recorder import (  # noqa: E402
     KIND_NAMES,
     FlightRecorder,
+    chrome_trace,
     validate_chrome_trace,
+)
+from minpaxos_tpu.obs.trace import (  # noqa: E402
+    ST_COMMIT,
+    ST_DECODE,
+    ST_DRAIN,
+    ST_EXEC,
+    ST_ORIGIN,
+    ST_REPLY_RECV,
+    ST_REPLY_SER,
+    ST_SEND,
+    TraceSink,
+    analyze_collections,
+    span_events,
 )
 from minpaxos_tpu.runtime.master import (  # noqa: E402
     Master,
@@ -127,6 +141,70 @@ def overhead_guard() -> bool:
     return ok
 
 
+def trace_overhead_guard() -> bool:
+    """paxtrace hot-path budget (ISSUE 12): the per-command cost of
+    tracing-on must stay under 30 us — an order of magnitude under
+    the serial path's millisecond scale, so a tracing-on serial p50
+    stays within noise of tracing-off. Measured the way the runtime
+    actually pays it: one vectorized sampling hash per 512-command
+    batch plus span stamps for the sampled commands (1-in-16 at the
+    default exponent), against the same loop with tracing off."""
+    import numpy as np
+
+    sink_on = TraceSink(enabled=True, sample_pow2=4, ring_capacity=8192)
+    sink_off = TraceSink(enabled=False, sample_pow2=4)
+    batches = [np.arange(i * 512, (i + 1) * 512, dtype=np.int64)
+               for i in range(8)]
+    n_cmds = 512 * len(batches)
+    reps = 40
+
+    def run(sink) -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for ids in batches:
+                if sink.enabled:
+                    # the replica drain path: one hash + stamps
+                    sink.stamp_batch(ST_DRAIN, ids, 1, 2, aux=0)
+        return time.perf_counter() - t0
+
+    run(sink_on), run(sink_off)  # warm allocator/bytecode
+    off_s = run(sink_off)
+    on_s = run(sink_on)
+    per_cmd = (on_s - off_s) / (n_cmds * reps)
+    ok = per_cmd < OVERHEAD_BOUND_S
+    stamped = sink_on.spans_total()
+    print(f"[obs_smoke] paxtrace overhead: {per_cmd * 1e6:.3f} us/command "
+          f"({stamped} spans stamped over {n_cmds * reps} commands, "
+          f"bound {OVERHEAD_BOUND_S * 1e6:.0f} us) — "
+          f"{'ok' if ok else 'FAIL'}", flush=True)
+    assert stamped > 0, "guard loop never stamped a span"
+    return ok
+
+
+def _seed_trace_sink() -> TraceSink:
+    """A sink holding complete span chains for 8 commands, as a live
+    replica's TRACESPANS verb would serve them (cluster-side stages;
+    two commands additionally carry the client-side SEND/REPLY_RECV
+    so the merge path is covered too)."""
+    sink = TraceSink(enabled=True, sample_pow2=0, ring_capacity=256)
+    ring = sink.ring()
+    from minpaxos_tpu.obs.trace import trace_id_for
+
+    t = 2_000_000_000
+    for cmd in range(8):
+        tid = trace_id_for(cmd)
+        t += 5_000_000
+        ring.record(tid, ST_SEND, t, t + 100_000, cmd)
+        ring.record(tid, ST_ORIGIN, t, t, cmd)
+        ring.record(tid, ST_DECODE, t + 300_000, t + 400_000, cmd)
+        ring.record(tid, ST_DRAIN, t + 900_000, t + 900_000, 10 + cmd)
+        ring.record(tid, ST_COMMIT, t + 2_400_000, t + 2_400_000, cmd)
+        ring.record(tid, ST_EXEC, t + 2_600_000, t + 2_600_000, 12 + cmd)
+        ring.record(tid, ST_REPLY_SER, t + 2_600_000, t + 2_700_000, cmd)
+        ring.record(tid, ST_REPLY_RECV, t + 3_000_000, t + 3_000_000, cmd)
+    return sink
+
+
 def _seed_replica_obs() -> tuple[MetricsRegistry, FlightRecorder]:
     """A registry + recorder as a live replica would carry, with every
     dispatch regime represented so the trace smoke covers all four —
@@ -159,16 +237,18 @@ def _seed_replica_obs() -> tuple[MetricsRegistry, FlightRecorder]:
 
 
 def _fake_replica_control(ctl_sock: socket.socket, reg, rec,
-                          stop: threading.Event) -> None:
-    """Answer ping/stats/trace on a control socket exactly like
-    runtime/replica.py's control plane (JSON lines)."""
+                          stop: threading.Event, sink=None) -> None:
+    """Answer ping/stats/trace/tracespans on a control socket exactly
+    like runtime/replica.py's control plane (JSON lines)."""
     def serve(conn):
         f = conn.makefile("rw")
         try:
             for line in f:
                 req = json.loads(line)
                 m = req.get("m")
-                if m == "ping":
+                if m == "tracespans" and sink is not None:
+                    resp = {"ok": True, "id": 0, "trace": sink.collect()}
+                elif m == "ping":
                     resp = {"ok": True, "frontier": 123, "leader": 0,
                             "stats": reg.counters(), "fatal": None}
                 elif m == "stats":
@@ -211,13 +291,18 @@ def paxtop_smoke() -> bool:
     master = Master("127.0.0.1", mport, 1, ping_s=30.0)
     master.start()
     reg, rec = _seed_replica_obs()
+    sink = _seed_trace_sink()
+    # the runtime registers these fn-gauges in ReplicaServer.__init__;
+    # paxtop's TRACE column reads them out of the stats snapshot
+    reg.fn_gauge("trace_spans", sink.spans_total)
+    reg.fn_gauge("trace_dropped", sink.spans_dropped)
     ctl = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     ctl.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     ctl.bind(("127.0.0.1", dport + CONTROL_OFFSET))
     ctl.listen(8)
     stop = threading.Event()
     threading.Thread(target=_fake_replica_control,
-                     args=(ctl, reg, rec, stop), daemon=True).start()
+                     args=(ctl, reg, rec, stop, sink), daemon=True).start()
     ok = True
     try:
         register_with_master(("127.0.0.1", mport), "127.0.0.1", dport,
@@ -257,8 +342,46 @@ def paxtop_smoke() -> bool:
         row = payload["derived"][0]
         assert row["ok"] and row["dispatches"] == 30, row
         assert abs(sum(row["mix_pct"].values()) - 100.0) < 1e-6, row
+        assert row["trace_spans"] == sink.spans_total(), row
         print("[obs_smoke] paxtop --once --json + trace fan-out: ok",
               flush=True)
+
+        # paxtrace leg: tools/tail.py --once --json (a real
+        # subprocess, no JAX import there either) through the master's
+        # TRACESPANS fan-out, stage-sum consistency, and the merged
+        # schema-v5 trace (recorder ticks + command-span tracks)
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools/tail.py"),
+             "-mport", str(mport), "--once", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        tail = json.loads(out.stdout)
+        table = tail["stage_table"]
+        assert table["n_traced"] == 8, table
+        assert table["tail"]["worst_stage"] == "commit", table["tail"]
+        for d in tail["per_trace"]:
+            assert abs(sum(d["stages"].values()) - d["total_ms"]) < 1e-9
+        table2, decomp, chains = analyze_collections([sink.collect()])
+        merged = chrome_trace(rec.to_events(pid=0)
+                              + span_events(decomp, chains))
+        errs = validate_chrome_trace(merged)
+        assert not errs, errs[:5]
+        assert table2["n_traced"] == 8
+
+        # the paxtop contract, pinned hard: importing tail.py's whole
+        # module graph must not pull in JAX (a transitive jax import
+        # would make every tail/paxtop invocation pay backend init)
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, runpy; "
+             f"runpy.run_path({str(REPO / 'tools/tail.py')!r}, "
+             "run_name='probe'); "
+             "assert 'jax' not in sys.modules, "
+             "'jax leaked onto the tail.py import path'"],
+            capture_output=True, text=True, timeout=60)
+        assert probe.returncode == 0, probe.stderr
+        print("[obs_smoke] tail --once --json + merged v5 command-span "
+              "trace + no-jax import pin: ok", flush=True)
     except AssertionError as e:
         print(f"[obs_smoke] paxtop smoke FAILED: {e}", file=sys.stderr,
               flush=True)
@@ -387,6 +510,7 @@ def main() -> int:
     if "--resident" in sys.argv[1:]:
         return 0 if resident_telemetry_smoke() else 1
     ok = overhead_guard()
+    ok = trace_overhead_guard() and ok
     ok = paxtop_smoke() and ok
     return 0 if ok else 1
 

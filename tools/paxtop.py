@@ -8,8 +8,9 @@ gauge between polls), the dispatch-regime mix (full / fused / narrow /
 idle-skip — PR 1's multi-modal tick cost, finally visible), exec
 backlog, paxchaos injected-fault totals and narrow-anchor fallbacks
 (a running chaos campaign or a flapping narrow view is visible
-without a trace dump), and p50/p99 tick wall from the typed
-histogram.
+without a trace dump), the paxtrace TRACE column (sampled spans
+collected / ring-overwrite drops — whether tools/tail.py has data to
+attribute), and p50/p99 tick wall from the typed histogram.
 
     python tools/paxtop.py -mport 7087              # live, 1s refresh
     python tools/paxtop.py -mport 7087 -i 0.5       # faster refresh
@@ -76,6 +77,10 @@ def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
         # forcing full-width recounts) both show in the table
         row["chaos_injected"] = counters.get("chaos_injected", 0)
         row["narrow_fallbacks"] = counters.get("narrow_fallbacks", 0)
+        # paxtrace health: sampled spans collected + ring-overwrite
+        # drops (a live view of whether tail.py has data to attribute)
+        row["trace_spans"] = counters.get("trace_spans", 0)
+        row["trace_dropped"] = counters.get("trace_dropped", 0)
         scal = r.get("scalars") or {}
         row["exec_backlog"] = (row["frontier"] + 1
                                - (scal.get("executed", row["frontier"]) + 1))
@@ -96,6 +101,19 @@ def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
     return rows
 
 
+def _abbrev(n: int) -> str:
+    """Compact count for fixed-width columns: the TRACE pair is a
+    lifetime-monotone span total, so a long-lived server would
+    otherwise overflow its field and shear every column after it."""
+    if n >= 10_000_000:
+        return f"{n / 1e6:.0f}M"
+    if n >= 1_000_000:
+        return f"{n / 1e6:.1f}M"
+    if n >= 10_000:
+        return f"{n / 1e3:.0f}k"
+    return str(n)
+
+
 def _render(resp: dict, rows: list[dict], clear: bool) -> None:
     out = []
     if clear:
@@ -107,7 +125,7 @@ def _render(resp: dict, rows: list[dict], clear: bool) -> None:
     hdr = (f"{'ID':>2} {'ROLE':<8} {'ST':<2} {'FRONTIER':>9} {'LAG':>6} "
            f"{'COMMIT/S':>9} {'BACKLOG':>8} {'DISP':>8} {'FULL%':>6} "
            f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'CHAOS':>7} "
-           f"{'NARRFB':>6} {'p50ms':>7} {'p99ms':>8}")
+           f"{'NARRFB':>6} {'TRACE':>11} {'p50ms':>7} {'p99ms':>8}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for r in rows:
@@ -124,7 +142,9 @@ def _render(resp: dict, rows: list[dict], clear: bool) -> None:
             f"{r['dispatches']:>8} {mix.get('full', 0):>6.1f} "
             f"{mix.get('fused', 0):>6.1f} {mix.get('narrow', 0):>6.1f} "
             f"{r['idle_skips']:>8} {r['chaos_injected']:>7} "
-            f"{r['narrow_fallbacks']:>6} {r['tick_p50_ms']:>7.2f} "
+            f"{r['narrow_fallbacks']:>6} "
+            f"{_abbrev(r['trace_spans']) + '/' + _abbrev(r['trace_dropped']):>11} "
+            f"{r['tick_p50_ms']:>7.2f} "
             f"{r['tick_p99_ms']:>8.2f}")
     print("\n".join(out), flush=True)
 
