@@ -771,12 +771,21 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             p50, p99, n_lat, uncommitted = _latency_rounds(
                 uptos, crts, round_ms)
             committed_total = int((uptos[-1] + 1).sum())
+        # paxwatch journal for this bench PROCESS: the loud paths land
+        # as queryable events (stamped into the artifact and, under
+        # --trace, the merged timeline) — the stdout lines themselves
+        # stay byte-identical
+        from minpaxos_tpu.obs.watch import EV_LATENCY_OVERFLOW, EventJournal
+
+        watch_journal = EventJournal(capacity=64)
         warn = overflow_warning(hist_overflow)
         if warn:
             # LOUD, on stdout next to the record itself (the artifact
             # stamp alone was missable)
             print(warn, flush=True)
             _progress(warn)
+            watch_journal.record(EV_LATENCY_OVERFLOW, subject=-1,
+                                 value=int(hist_overflow))
         result = {
             "metric": "committed_instances_per_sec",
             "value": round(throughput, 1),
@@ -828,6 +837,9 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             "proposals_per_round": g * p,
             "committed_total": committed_total,
             "metrics": mx.snapshot(),
+            # paxwatch: this process's journaled loud-path events
+            # (latency-histogram overflow today; {} = clean run)
+            "watch_events": watch_journal.counts_by_kind(),
             "kill_recover": kill_recover,
             "n_replicas": cfg.n_replicas,
             "n_shards": g,
@@ -862,6 +874,12 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             events = host_rec.to_events(pid=0)
             if tel_rows is not None and len(tel_rows):
                 events += device_round_events(tel_rows, disp_log, g)
+            if watch_journal.events_total():
+                # schema v6: journaled incidents as instant events on
+                # the reserved WATCH_PID, next to the dispatch slices
+                from minpaxos_tpu.obs.watch import event_chrome_events
+
+                events += event_chrome_events(watch_journal.snapshot())
             trace = chrome_trace(events)
             errs = validate_chrome_trace(trace)
             if errs:

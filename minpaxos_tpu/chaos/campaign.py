@@ -35,6 +35,7 @@ import numpy as np
 # (verify/mc.py) explores exhaustively — one invariant catalogue, two
 # provers (VERIFY.md)
 from minpaxos_tpu.chaos.plan import FaultPlan
+from minpaxos_tpu.obs.watch import SLO, HealthWatcher
 from minpaxos_tpu.verify.invariants import check_cluster
 
 #: committed-frontier sample cadence during load (drives the
@@ -236,6 +237,7 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
     t_wall = time.monotonic()
     result = {"schedule": name, "seed": seed, "ok": False, "events":
               [(round(t, 3), op) for t, op, _ in events]}
+    watcher: HealthWatcher | None = None
     samples: dict[int, list[int]] = {i: [] for i in range(n)}
     sample_t: list[float] = []
     stop_sampling = threading.Event()
@@ -280,16 +282,33 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
         cli = cluster.client(backoff_seed=seed)
         smp = threading.Thread(target=sampler, daemon=True)
         smp.start()
+        # paxwatch rides along on EVERY schedule: the live detector
+        # loop polling the real master stats fan-out — the exact path
+        # tools/paxwatch.py uses against a deployment. For the stall
+        # schedules its frontier-stall alarm is part of the verdict
+        # (detected AND attributed live, not just checked post-hoc).
+        from minpaxos_tpu.runtime.master import cluster_stats
+
+        watcher = HealthWatcher(
+            poll_fn=lambda: cluster_stats(cluster.maddr, timeout_s=5.0),
+            slo=SLO(stall_s=0.6, stall_slack_slots=STALL_SLACK_SLOTS,
+                    churn_window_s=5.0, churn_budget=4),
+            interval_s=0.25)
+        watcher.start()
         t0 = time.monotonic()
+        t0_wall = time.time()
         loader = threading.Thread(target=load, daemon=True)
         loader.start()
-        fault_marks: list[tuple[float, str]] = []
+        # (mono, wall, op) per fired chaos event: the ground-truth
+        # fault timeline the stall-detector assertion compares against
+        # (wall joins the watcher's samples, mono the frontier samples)
+        fault_marks: list[tuple[float, float, str]] = []
         for t_off, op, plan in events:
             delay = t0 + t_off - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
             r = cluster_chaos(cluster.maddr, op=op, plan=plan)
-            fault_marks.append((time.monotonic(), op))
+            fault_marks.append((time.monotonic(), time.time(), op))
             if not r.get("ok"):
                 result["error"] = f"chaos fan-out failed: {r}"
                 return result
@@ -325,6 +344,32 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
         result["converged"] = converged
         stop_sampling.set()
         smp.join(timeout=2.0)
+        # the watcher outlives the resume leg on purpose: a raised
+        # stall alarm must be observed CLEARING once commits resume
+        watcher.stop()
+        result["fault_timeline"] = [
+            {"t_rel_s": round(tm - t0, 3), "wall_s": tw, "op": op}
+            for tm, tw, op in fault_marks]
+        result["watch"] = watcher.summary()
+        result["watch"]["poll_errors"] = watcher.poll_errors
+        if name in STALL_SCHEDULES:
+            result["watch"]["stall"] = _stall_verdict(
+                watcher, fault_marks, expected_subject=0)
+        result["client_events"] = cli.journal.counts_by_kind()
+        # cluster-wide EVENTS fan-out: the journals must show the
+        # fault-plan installs/clears this schedule just drove
+        from minpaxos_tpu.runtime.master import cluster_events
+
+        ev_resp = cluster_events(cluster.maddr)
+        from minpaxos_tpu.obs.watch import (
+            align_event_collections,
+            counts_by_kind,
+        )
+
+        kinds = counts_by_kind(align_event_collections(
+            [r["journal"] for r in ev_resp.get("replicas", [])
+             if r.get("ok") and r.get("journal")]))
+        result["cluster_events"] = kinds
         time.sleep(0.3)  # quiesce: no in-flight appends under the checker
         with cli._lock:
             replies = dict(cli.replies)
@@ -339,17 +384,26 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
         if name in STALL_SCHEDULES:
             result["stall_observed"] = _stalled_during_fault(
                 sample_t, samples, fault_marks)
+        stall_live = True
+        if name in STALL_SCHEDULES:
+            sv = result["watch"]["stall"]
+            stall_live = (sv["fired_in_window"] and sv["attributed"]
+                          and sv["cleared"])
         result["ok"] = (report.ok and converged
                         and result["resumed_commits"]
                         and result["expected"] > 0
                         and result["acked"] == result["expected"]
                         and result["faults_injected"] > 0
                         and result["duplicates"] == 0
-                        and result.get("stall_observed", True))
+                        and result.get("stall_observed", True)
+                        and kinds.get("chaos_install", 0) >= n
+                        and stall_live)
         return result
     finally:
         stop_sampling.set()
         stop_load.set()
+        if watcher is not None:
+            watcher.stop()
         if cli is not None:
             cli._done = True
             cli.close_conn()
@@ -369,13 +423,47 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
                     f"--seeds {seed}")
 
 
+def _stall_verdict(watcher: HealthWatcher,
+                   fault_marks: list[tuple[float, float, str]],
+                   expected_subject: int) -> dict:
+    """The live-detection verdict for a stall schedule: did the
+    frontier-stall alarm RAISE inside the installed-fault window
+    (wall-clock ground truth from the fired chaos events), did it
+    name the isolated replica, and did it CLEAR once the cluster
+    healed and resumed committing. This is the closed loop the paxwatch
+    layer exists for — the same stall the offline checker proves from
+    frontier samples, detected and attributed while it was happening."""
+    installs = [tw for _, tw, op in fault_marks if op == "install"]
+    clears = [tw for _, tw, op in fault_marks if op == "clear"]
+    stall = [a for a in watcher.alarms
+             if a["detector"] == "frontier_stall"]
+    lo = installs[0] if installs else float("inf")
+    hi = (clears[0] if clears else float("inf")) + 1.0
+    in_win = [a for a in stall if lo <= a["t_raised"] <= hi]
+    return {
+        "fired_in_window": bool(in_win),
+        "attributed": any(a["subject"] == expected_subject
+                          for a in in_win),
+        "cleared": bool(stall) and all(a["t_cleared"] is not None
+                                       for a in stall),
+        "n_alarms": len(stall),
+        "window_wall": [lo, hi],
+        "alarms": [{"t_raised": a["t_raised"],
+                    "t_cleared": a["t_cleared"],
+                    "subject": a["subject"],
+                    "evidence": a["evidence"]} for a in stall],
+    }
+
+
 def _stalled_during_fault(sample_t: list[float],
                           samples: dict[int, list[int]],
-                          fault_marks: list[tuple[float, str]]) -> bool:
+                          fault_marks: list[tuple[float, float, str]]
+                          ) -> bool:
     """True when commit progress stopped while the fault was installed
-    (after a short settle for in-flight traffic)."""
-    installs = [t for t, op in fault_marks if op == "install"]
-    clears = [t for t, op in fault_marks if op == "clear"]
+    (after a short settle for in-flight traffic). Offline twin of the
+    live _stall_verdict, from the campaign's own frontier samples."""
+    installs = [tm for tm, _, op in fault_marks if op == "install"]
+    clears = [tm for tm, _, op in fault_marks if op == "clear"]
     if not installs or not clears:
         return False
     lo, hi = installs[0] + 0.4, clears[0]
@@ -416,10 +504,16 @@ def run_campaign(schedules: list[str], seeds: list[int], n: int = 3,
             t_budget = time.monotonic()  # first run covered jit compile
         results.append(r)
         ok = ok and r["ok"]
+        w = r.get("watch") or {}
+        stall = w.get("stall") or {}
         log(f"[paxchaos]   -> {'ok' if r['ok'] else 'FAIL'} "
             f"acked={r.get('acked')}/{r.get('expected')} "
             f"faults={r.get('faults_injected')} "
-            f"wall={r.get('wall_s')}s")
+            f"alarms={w.get('alarm_counts', {})}"
+            + (f" stall_live={stall.get('fired_in_window')}"
+               f"/subject_ok={stall.get('attributed')}"
+               f"/cleared={stall.get('cleared')}" if stall else "")
+            + f" wall={r.get('wall_s')}s")
         remaining = len(pairs) - i - 1
         if (budget_s is not None and remaining
                 and time.monotonic() - t_budget > budget_s):

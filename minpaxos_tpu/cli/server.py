@@ -127,6 +127,15 @@ def main(argv=None) -> None:
     p.add_argument("-recring", type=int, default=4096,
                    help="flight-recorder ring capacity in ticks"
                         " (12 int64 fields per row: 4096 ≈ 384 KiB)")
+    p.add_argument("-nowatch", action="store_true",
+                   help="disable the paxwatch event journal (the"
+                        " cluster-event rings served by the control"
+                        " socket's EVENTS verb; OBSERVABILITY.md) —"
+                        " elections, failovers, chaos installs and"
+                        " alarms then stay stdout-only")
+    p.add_argument("-watchring", type=int, default=1024,
+                   help="paxwatch event-ring capacity per writer"
+                        " thread (8 int64 fields per event)")
     p.add_argument("-storedir", default=".",
                    help="stable store directory")
     p.add_argument("-platform", default="cpu",
@@ -199,6 +208,8 @@ def main(argv=None) -> None:
                          trace=not args.notrace,
                          trace_pow2=args.tracepow2,
                          trace_ring=args.tracering,
+                         watch=not args.nowatch,
+                         watch_ring=args.watchring,
                          profile=prof)
     server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags,
                            protocol=protocol)

@@ -11,9 +11,14 @@ drain / enqueue / readback / persist / dispatch / reply, plus the
 pipeline's device-hidden host wall as overlap_us), exportable as
 Chrome trace-event JSON loadable in Perfetto.
 
+Siblings in this package: ``obs/trace.py`` (paxtrace — sampled
+per-command stage spans) and ``obs/watch.py`` (paxwatch — the
+cluster-event journal, health-sample retention, and SLO/anomaly
+detectors).
+
 Deliberately dependency-light (stdlib + numpy, no jax): the control
-plane, ``tools/paxtop.py`` and the CI smoke (``tools/obs_smoke.py``)
-must all run cold without a backend init.
+plane, ``tools/paxtop.py``, ``tools/paxwatch.py`` and the CI smoke
+(``tools/obs_smoke.py``) must all run cold without a backend init.
 
 Consumers:
 
@@ -38,6 +43,7 @@ from minpaxos_tpu.obs.metrics import (
 from minpaxos_tpu.obs.recorder import (
     DEVICE_PID,
     TRACE_PID,
+    WATCH_PID,
     FlightRecorder,
     KIND_FULL,
     KIND_FUSED,
@@ -68,6 +74,19 @@ from minpaxos_tpu.obs.trace import (
     stage_table,
     trace_id_for,
 )
+from minpaxos_tpu.obs.watch import (
+    DETECTOR_NAMES,
+    EVENT_FIELD_NAMES,
+    EVENT_NAMES,
+    EventJournal,
+    EventRing,
+    HealthSeries,
+    HealthWatcher,
+    SLO,
+    align_event_collections,
+    event_chrome_events,
+    flatten_cluster_stats,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -81,4 +100,8 @@ __all__ = [
     "is_sampled",
     "sampled_mask", "span_chains", "span_events",
     "stage_decomposition", "stage_table", "trace_id_for",
+    "WATCH_PID", "DETECTOR_NAMES", "EVENT_FIELD_NAMES", "EVENT_NAMES",
+    "EventJournal", "EventRing", "HealthSeries", "HealthWatcher",
+    "SLO", "align_event_collections", "event_chrome_events",
+    "flatten_cluster_stats",
 ]

@@ -50,8 +50,14 @@ import numpy as np
 #: (obs/trace.py) — stage slices for sampled commands under the
 #: reserved TRACE_PID, so one merged file shows a command's client ->
 #: replica -> device-rounds -> reply chain next to the tick and
-#: device-round tracks. Tick-row layout again unchanged.)
-SCHEMA_VERSION = 5
+#: device-round tracks. Tick-row layout again unchanged. v6: paxwatch
+#: cluster-event tracks (obs/watch.py) — journal events (elections,
+#: leader changes, failovers, chaos installs, store-corruption
+#: recoveries, narrow fallbacks, alarms) rendered as instant events
+#: under the reserved WATCH_PID, so one merged file shows WHEN the
+#: cluster's incidents happened against the tick / device-round /
+#: command-span tracks. Tick-row layout unchanged from v3.)
+SCHEMA_VERSION = 6
 
 # dispatch regimes (runtime/replica.py classifies one per tick:
 # narrow > fused > full; idle-skip never reaches the device)
@@ -108,6 +114,11 @@ DEVICE_PID = 9999
 #: schema v5: reserved pid for paxtrace per-command span tracks
 #: (obs/trace.py emits them; it imports this constant)
 TRACE_PID = 9998
+
+#: schema v6: reserved pid for paxwatch cluster-event tracks
+#: (obs/watch.py emits them; it imports this constant). The validator
+#: pins the reservation both directions, like its two siblings.
+WATCH_PID = 9997
 
 # telemetry-row field layout (glossary in OBSERVABILITY.md):
 # round — absolute protocol round index (-1 = row never written);
@@ -403,4 +414,15 @@ def validate_chrome_trace(trace) -> list[str]:
         elif ev.get("pid") == TRACE_PID:
             errs.append(f"{where}: pid {TRACE_PID} is reserved for "
                         f"paxtrace command-span tracks")
+        # schema v6: paxwatch cluster-event tracks live on WATCH_PID
+        # and nothing else may squat there — instant events from the
+        # journal must not interleave with replica/device/span tracks
+        is_watch = ev.get("cat") == "paxwatch"
+        if is_watch and ev.get("pid") != WATCH_PID:
+            errs.append(f"{where}: paxwatch event must carry the "
+                        f"reserved pid {WATCH_PID}, got "
+                        f"{ev.get('pid')!r}")
+        elif not is_watch and ev.get("pid") == WATCH_PID:
+            errs.append(f"{where}: pid {WATCH_PID} is reserved for "
+                        f"paxwatch cluster-event tracks")
     return errs

@@ -31,6 +31,7 @@ from minpaxos_tpu.obs.trace import (
     monotonic_ns,
     trace_id_for,
 )
+from minpaxos_tpu.obs.watch import EV_CLIENT_FAILOVER, EventJournal
 from minpaxos_tpu.runtime.master import (
     backoff_sleeps,
     get_leader,
@@ -100,6 +101,12 @@ class Client:
         self._c_backoff_sleeps = self.metrics.counter(
             "backoff_sleeps", "failover rounds that found NO reachable "
             "replica and slept a jittered exponential backoff")
+        # paxwatch journal: failovers become queryable events (which
+        # replica the client landed on, when, wall+mono stamped) next
+        # to the cluster-side journals — a chaos campaign's CHAOS.json
+        # carries the counts, and events_collect() hands the rows to
+        # whoever merges the incident timeline
+        self.journal = EventJournal(capacity=256)
         # failover backoff (seeded): when no replica answers, sleeps
         # grow 50 ms -> 2 s with U[0.5, 1.0] jitter instead of the old
         # fixed 0.5 s — a fleet of chaos-campaign clients redialing a
@@ -213,6 +220,12 @@ class Client:
         off) — merged with the cluster's TRACESPANS fan-out by
         tools/tail.py / bench_tcp to close chains client-to-client."""
         return None if self.trace is None else self.trace.collect()
+
+    def events_collect(self) -> dict:
+        """This client's paxwatch journal collection (anchored like
+        the cluster-side EVENTS verb payloads, so
+        align_event_collections merges it into the same timeline)."""
+        return self.journal.collect()
 
     # -- propose / wait --
 
@@ -359,11 +372,15 @@ class Client:
                 self.connect(rid)
                 self.leader = rid
                 self._backoff = None  # reachable again: reset the streak
+                self.journal.record(EV_CLIENT_FAILOVER, subject=rid,
+                                    value=self._c_failovers.value)
                 dlog(f"client: failed over to replica {rid}")
                 return
             except OSError:
                 continue
         # nothing reachable: jittered exponential backoff (see __init__)
+        self.journal.record(EV_CLIENT_FAILOVER, subject=-1,
+                            value=self._c_failovers.value)
         if self._backoff is None:
             self._backoff = backoff_sleeps(0.05, 2.0, self._backoff_rng)
         self._c_backoff_sleeps.inc()

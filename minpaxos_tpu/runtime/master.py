@@ -104,7 +104,7 @@ class Master:
 
     def _handle(self, req: dict) -> dict:
         m = req.get("m")
-        if m in ("stats", "trace", "chaos", "tracespans"):
+        if m in ("stats", "trace", "chaos", "tracespans", "events"):
             # paxmon/paxchaos fan-out verbs: these poll every replica's
             # control socket, so they must NOT run under the membership
             # lock — one slow replica's 2 s control timeout would stall
@@ -154,7 +154,7 @@ class Master:
             nodes = list(enumerate(self.nodes))
             leader = self.leader
             alive = list(self.alive)
-        if m in ("stats", "tracespans"):
+        if m in ("stats", "tracespans", "events"):
             sub = {"m": m}
         elif m == "trace":
             sub = {"m": "trace", "last": req.get("last")}
@@ -369,6 +369,18 @@ def cluster_chaos(maddr: tuple[str, int], op: str = "status",
     the cluster faulted behind a 'healed' campaign."""
     return _rpc(maddr, {"m": "chaos", "op": op, "plan": plan},
                 timeout=timeout_s)
+
+
+def cluster_events(maddr: tuple[str, int],
+                   timeout_s: float = 15.0) -> dict:
+    """paxwatch fan-out: every replica's event-journal collection
+    (elections, leader changes, chaos installs, narrow fallbacks,
+    store-corruption recoveries, peer link churn, fail-stops), each
+    with its (mono, wall) clock anchor —
+    ``obs.watch.align_event_collections`` merges them into one
+    cluster incident timeline. Consumed by ``tools/paxwatch.py`` and
+    paxtop's EVENTS pane."""
+    return _rpc(maddr, {"m": "events"}, timeout=timeout_s)
 
 
 def cluster_tracespans(maddr: tuple[str, int],

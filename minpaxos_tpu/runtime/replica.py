@@ -60,6 +60,17 @@ from minpaxos_tpu.obs.trace import (
     TraceSink,
     trace_id_for,
 )
+from minpaxos_tpu.obs.watch import (
+    EV_CHAOS_CLEAR,
+    EV_CHAOS_INSTALL,
+    EV_ELECTION,
+    EV_FATAL,
+    EV_LEADER_CHANGE,
+    EV_NARROW_FALLBACK,
+    EV_STORE_CORRUPT,
+    EventJournal,
+    event_chrome_events,
+)
 from minpaxos_tpu.ops.kvstore import LIVE
 from minpaxos_tpu.ops.packed import join_i64, split_i64
 from minpaxos_tpu.ops.substeps import (
@@ -255,6 +266,15 @@ class RuntimeFlags:
     trace: bool = True
     trace_pow2: int = 4
     trace_ring: int = 4096
+    # paxwatch event journal (obs/watch.py): structured cluster events
+    # (elections, leader changes, chaos installs, narrow fallbacks,
+    # store-corruption recoveries, fail-stops, peer link up/down)
+    # served over the control socket's EVENTS verb and rendered as
+    # instant events in merged traces (schema v6). Default ON — a
+    # journal write is one ring slice-assign plus two clock reads
+    # (the obs_smoke <=5 us/event guard pins it); -nowatch disables.
+    watch: bool = True
+    watch_ring: int = 1024
     store_dir: str = "."
     # -cpuprofile: a cProfile.Profile the PROTOCOL THREAD enables on
     # start (cProfile is per-thread; enabling it on the main thread —
@@ -323,6 +343,14 @@ class ReplicaServer:
             "through the full-width step")
         self._c_proposals = m.counter("proposals", "client command rows "
                                       "admitted to the inbox")
+        self._c_rejected = m.counter(
+            "proposals_rejected", "admitted command rows the kernel "
+            "bounced back to the client (not leader / unprepared) — "
+            "no log slot was assigned, so paxwatch's in-flight "
+            "estimate (proposals - rejected - committed) subtracts "
+            "them; without this a boot-window rejection burst biases "
+            "the estimate high forever and an IDLE cluster looks "
+            "permanently loaded to the stall detector")
         self._c_executed = m.counter("executed", "commands executed")
         self._g_committed = m.gauge("committed",
                                     "committed prefix length (frontier+1)")
@@ -345,6 +373,17 @@ class ReplicaServer:
                                     ring_capacity=self.flags.trace_ring)
         m.fn_gauge("trace_spans", self.trace_sink.spans_total)
         m.fn_gauge("trace_dropped", self.trace_sink.spans_dropped)
+        # paxwatch journal: one per replica, shared with the
+        # transport's reader threads (each writer thread gets its own
+        # ring inside) — the journal exists even when disabled so
+        # every touch point stays one `.enabled` test
+        self.journal = EventJournal(enabled=self.flags.watch,
+                                    capacity=self.flags.watch_ring)
+        m.fn_gauge("events", self.journal.events_total)
+        m.fn_gauge("events_dropped", self.journal.events_dropped)
+        self._c_elections = m.counter(
+            "elections", "become_leader rounds this replica ran "
+            "(paxwatch churn detection reads the cluster-wide delta)")
         # sampled in-flight bookkeeping (protocol thread only): a
         # min-heap of (log slot, cmd_id) awaiting commit stamps
         # (bounded by the sampled in-flight count, 1-in-2^k of the
@@ -356,6 +395,7 @@ class ReplicaServer:
         self._last_scals = None  # newest published scalar vector
         self.transport = Transport(me, addrs, metrics=self.metrics)
         self.transport.trace = self.trace_sink
+        self.transport.journal = self.journal
         self.queue = self.transport.queue
         # the MODULE-level jitted packed step (static cfg + impl):
         # every replica in the process shares ONE compile cache — N
@@ -545,6 +585,13 @@ class ReplicaServer:
                        ballot=max_ballot,
                        last_committed=int(np.asarray(self.state.committed_upto)))
             self._device_tick(buf)
+        if self.store.corrupt_records:
+            # the stable store's replay already printed its (parser-
+            # safe, byte-identical) warning lines; the journal makes
+            # the recovery QUERYABLE — paxtop's HEALTH column and the
+            # EVENTS fan-out see it without scraping stderr
+            self.journal.record(EV_STORE_CORRUPT, subject=self.me,
+                                value=self.store.corrupt_records)
         dlog(f"replica {self.me}: recovered frontier={frontier} "
              f"tail={len(tail)} ballot={max_ballot}")
 
@@ -650,9 +697,25 @@ class ReplicaServer:
                               self.recorder.to_events(
                                   pid=self.me,
                                   last=int(last) if last else 1024))
+                    if self.recorder is not None and self.journal.enabled:
+                        # paxwatch journal rides the merged timeline
+                        # as instant events on the reserved WATCH_PID
+                        # (schema v6), one tid per replica. Gated on
+                        # the recorder too: -norecorder keeps TRACE
+                        # answering empty-but-ok (pinned by test), and
+                        # the journal stays queryable via EVENTS.
+                        events += event_chrome_events(
+                            self.journal.snapshot(), tid=self.me)
                     resp = {"ok": True, "id": self.me,
                             "recorder": self.recorder is not None,
                             "events": events}
+                elif m == "events":
+                    # paxwatch EVENTS verb: the journal's retained
+                    # events (every writer thread's ring) plus the
+                    # (mono, wall) clock anchor align_event_collections
+                    # shifts processes into one domain by
+                    resp = {"ok": True, "id": self.me,
+                            "journal": self.journal.collect()}
                 elif m == "tracespans":
                     # paxtrace collection: every span ring of this
                     # process (protocol thread, transport readers) plus
@@ -694,8 +757,14 @@ class ReplicaServer:
                         f"has {self.cfg.n_replicas}")
                 self.transport.set_chaos(
                     ChaosShim(self.me, plan, self.queue))
+                # journaled from this control thread's own ring: a
+                # campaign's fault window is queryable next to the
+                # alarms it provoked (value = the plan's seed)
+                self.journal.record(EV_CHAOS_INSTALL, subject=self.me,
+                                    value=int(plan.seed))
             elif op == "clear":
                 self.transport.set_chaos(None)
+                self.journal.record(EV_CHAOS_CLEAR, subject=self.me)
             elif op != "status":
                 raise ValueError(f"unknown chaos op {op!r}")
         except (KeyError, TypeError, ValueError) as e:
@@ -1072,6 +1141,9 @@ class ReplicaServer:
                 if q != self.me:
                     self._send_or_redial(q, kind, frame)
         self.transport.flush_all()
+        self._c_elections.inc()
+        self.journal.record(EV_ELECTION, subject=self.me,
+                            value=self.snapshot["frontier"])
         dlog(f"replica {self.me}: running election")
 
     # message kinds whose rows address log slots (narrow-view gating
@@ -1254,6 +1326,7 @@ class ReplicaServer:
         # published at readback — strictly before the next tick's
         # fuse/narrow/idle decisions AND before this tick's
         # _host_catchup, exactly as in the serial order
+        prev_leader = self.snapshot["leader"]
         self.snapshot = {
             "frontier": frontier_last,
             "window_base": int(last[SCAL_WINDOW_BASE]),
@@ -1268,6 +1341,13 @@ class ReplicaServer:
             "high": int(last[SCAL_HIGH_ANCHOR]),
             "work_pending": bool(last[SCAL_WORK_PENDING]),
         }
+        if self.snapshot["leader"] != prev_leader:
+            # the device-published leader view moved: an election
+            # landed (ours or a peer's) — the journal's leader-change
+            # timeline is what the churn detector's evidence joins to
+            self.journal.record(EV_LEADER_CHANGE,
+                                subject=self.snapshot["leader"],
+                                value=frontier_last, aux=prev_leader)
         if narrow:
             # post-readback anchor validation (defense in depth for
             # the pipeline): the choose-time proof said every slot the
@@ -1288,6 +1368,9 @@ class ReplicaServer:
                     > view_lo + narrow):
                 self._c_narrow_fallbacks.inc()
                 self._narrow_doubt = True
+                self.journal.record(
+                    EV_NARROW_FALLBACK, subject=self.me,
+                    value=self._c_narrow_fallbacks.value)
                 dlog(f"replica {self.me}: narrow anchor validation "
                      f"FAILED (view [{view_lo}, {view_lo + narrow}), "
                      f"anchors [{int(scals[:, SCAL_LOW_ANCHOR].min())}, "
@@ -1314,6 +1397,8 @@ class ReplicaServer:
                 f"replica {self.me}: KV table saturated — {dropped} "
                 f"write(s) dropped (kv_pow2={self.cfg.kv_pow2} is too "
                 f"small for the live key space); failing stop")
+            self.journal.record(EV_FATAL, subject=self.me,
+                                value=dropped)
             raise FatalReplicaError(self.fatal)
         drain_s, self._drain_work_s = self._drain_work_s, 0.0
         rec = _InflightTick(
@@ -1634,6 +1719,7 @@ class ReplicaServer:
         # Leader} so clients re-route (bareminpaxos.go:618-625)
         rej = live & (dst == -2) & (kinds == int(MsgKind.PROPOSE_REPLY))
         if rej.any():
+            self._c_rejected.inc(int(rej.sum()))
             leader_hint = out_cols["ballot"][rej]
             cids = out_cols["client_id"][rej]
             cmds = out_cols["cmd_id"][rej]

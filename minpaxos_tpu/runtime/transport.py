@@ -24,6 +24,7 @@ import time
 import numpy as np
 
 from minpaxos_tpu.obs.trace import ST_DECODE
+from minpaxos_tpu.obs.watch import EV_PEER_DOWN, EV_PEER_UP
 from minpaxos_tpu.utils.clock import monotonic_ns
 from minpaxos_tpu.utils.dlog import dlog
 from minpaxos_tpu.wire.codec import FrameWriter, StreamDecoder
@@ -83,6 +84,12 @@ class Transport:
         # disabled path is one attribute load + is-None test per chunk,
         # and each reader thread writes only its OWN span ring.
         self.trace = None
+        # paxwatch journal (obs/watch.py): when installed, peer-link
+        # lifecycle (install / reader-loop death) is journaled so a
+        # flapping mesh is queryable. Same discipline as the trace
+        # sink: one attribute load + is-None test when absent, and
+        # every writer thread records into its own ring.
+        self.journal = None
         # per-peer dial suppression state: a refused dial doubles the
         # peer's suppression window instead of re-timing out every
         # 0.5 s — a flapping or partitioned peer must not price a
@@ -327,6 +334,9 @@ class Transport:
                 old.sock.close()
             except OSError:
                 pass
+        j = self.journal
+        if j is not None:
+            j.record(EV_PEER_UP, subject=q)
         dlog(f"replica {self.me}: peer {q} connected")
         threading.Thread(target=self._read_loop,
                          args=(FROM_PEER, q, conn), daemon=True).start()
@@ -371,6 +381,11 @@ class Transport:
             if dec.error is not None:
                 break
         conn.alive = False
+        j = self.journal
+        if (j is not None and src_kind == FROM_PEER
+                and not self._stop.is_set()):
+            # a peer link died mid-run (shutdown churn is not news)
+            j.record(EV_PEER_DOWN, subject=conn_id)
         self.queue.put((CONN_LOST, conn_id if src_kind == FROM_CLIENT
                         else -1 - conn_id, None, None))
         try:

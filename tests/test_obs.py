@@ -156,7 +156,7 @@ def test_trace_schema_version_stamped_and_checked():
     from minpaxos_tpu.obs.recorder import SCHEMA_VERSION
 
     tr = chrome_trace([])
-    assert tr["otherData"]["paxmonSchemaVersion"] == SCHEMA_VERSION == 5
+    assert tr["otherData"]["paxmonSchemaVersion"] == SCHEMA_VERSION == 6
     assert validate_chrome_trace(tr) == []
     stale = chrome_trace([])
     stale["otherData"]["paxmonSchemaVersion"] = 4
@@ -291,8 +291,39 @@ def test_stats_trace_verbs_master_fanout_and_paxtop(tmp_path):
         assert {rr["id"] for rr in ms["replicas"]} == {0, 1, 2}
         mt = cluster_trace(maddr, last=256)
         assert validate_chrome_trace(mt["trace"]) == []
+        from minpaxos_tpu.obs.recorder import WATCH_PID
+
         pids = {e["pid"] for e in mt["trace"]["traceEvents"]}
-        assert pids == {0, 1, 2}, pids
+        assert pids == {0, 1, 2, WATCH_PID}, pids
+
+        # paxwatch EVENTS fan-out (live cluster): replica 0 journaled
+        # its boot election, every replica its peer-link installs, and
+        # the collections carry the clock anchors the offline merge
+        # aligns by — and the merged v6 trace above already carried
+        # the journals as instant events on the reserved pid
+        from minpaxos_tpu.obs import watch as W
+        from minpaxos_tpu.runtime.master import cluster_events
+
+        ev = cluster_events(maddr)
+        assert ev["ok"] and len(ev["replicas"]) == 3
+        assert all(rr["ok"] and rr["journal"]["anchor"]["mono_ns"] > 0
+                   for rr in ev["replicas"]), ev["replicas"]
+        rows = W.align_event_collections(
+            [rr["journal"] for rr in ev["replicas"]])
+        kinds = set(rows[:, W.EV_KIND].tolist())
+        assert W.EV_ELECTION in kinds and W.EV_PEER_UP in kinds, kinds
+        j0 = [rr for rr in ev["replicas"] if rr["id"] == 0][0]["journal"]
+        r0 = np.asarray(j0["events"], np.int64)
+        elecs = r0[r0[:, W.EV_KIND] == W.EV_ELECTION]
+        assert len(elecs) >= 1 and int(elecs[0][W.EV_SUBJECT]) == 0
+        # the journal total rides stats as an fn-gauge (paxtop's feed)
+        assert cnt is not None  # (STATS leg above)
+        st0 = _ctl(h.addrs[0], {"m": "stats"})
+        assert st0["metrics"]["gauges"]["events"] >= j0["total"] > 0
+        wevs = [e for e in mt["trace"]["traceEvents"]
+                if e.get("cat") == "paxwatch"]
+        assert wevs and all(e["pid"] == WATCH_PID and e["ph"] == "i"
+                            for e in wevs)
 
         # the shipped live view, as a subprocess (no jax import there)
         out = subprocess.run(

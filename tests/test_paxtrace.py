@@ -291,9 +291,11 @@ def test_stage_decomposition_sums_to_end_to_end():
 
 
 def test_schema_v5_pins_both_directions():
-    """v5 readers reject v4-stamped traces; paxtrace events must ride
-    the reserved pid (and nothing else may squat on it)."""
-    assert SCHEMA_VERSION == 5
+    """Current-schema readers reject older-stamped traces; paxtrace
+    events must ride the reserved pid (and nothing else may squat on
+    it). (v6 bumped the stamp for paxwatch event tracks; the paxtrace
+    pid reservation is unchanged.)"""
+    assert SCHEMA_VERSION == 6
     spans = np.array(_chain(1, 10**9), np.int64)
     chains = T.span_chains(spans)
     decomp = T.stage_decomposition(chains)
@@ -303,7 +305,7 @@ def test_schema_v5_pins_both_directions():
                for e in events)
     tr = chrome_trace(events)
     assert validate_chrome_trace(tr) == []
-    # v4-stamped file fails against the v5 reader
+    # older-stamped file fails against the current reader
     stale = chrome_trace(events)
     stale["otherData"]["paxmonSchemaVersion"] = 4
     errs = validate_chrome_trace(stale)

@@ -1,0 +1,459 @@
+"""paxwatch: event-journal rings + anchor alignment, schema-v6
+reserved-pid pins, SLO/anomaly detector units on synthetic series
+(stall fire/no-fire boundary + attribution, churn budget, burn-rate
+math, backlog slope), HealthWatcher raise/clear edges, retention-layer
+bounds under a simulated week-long run, and the paxtop --once --json
+stable key schema (OBSERVABILITY.md documents it)."""
+
+import importlib.util
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.obs import watch as W
+from minpaxos_tpu.obs.recorder import (
+    WATCH_PID,
+    FlightRecorder,
+    chrome_trace,
+    validate_chrome_trace,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------- journal
+
+
+def test_event_ring_wraparound_keeps_newest():
+    r = W.EventRing(capacity=4)
+    for i in range(10):
+        r.record(1000 + i, 2000 + i, W.EV_ELECTION, 0, i, 0, 0, 0)
+    rows = r.snapshot()
+    assert rows.shape == (4, W.N_EVENT_FIELDS)
+    assert rows[:, W.EV_SUBJECT].tolist() == [6, 7, 8, 9]  # newest 4
+    assert r.total == 10 and r.dropped == 6
+
+
+def test_journal_per_thread_rings_and_counts():
+    j = W.EventJournal(capacity=64)
+    j.record(W.EV_ELECTION, subject=0)
+
+    def other():
+        j.record(W.EV_CLIENT_FAILOVER, subject=1)
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    # two writer threads -> two rings, both collected
+    assert j.events_total() == 2
+    assert j.counts_by_kind() == {"election": 1, "client_failover": 1}
+    rows = j.snapshot()
+    assert rows.shape[0] == 2
+    # merged snapshot is mono-sorted
+    assert rows[0, W.EV_MONO] <= rows[1, W.EV_MONO]
+    # default severities applied per kind
+    by_kind = {int(r[W.EV_KIND]): int(r[W.EV_SEV]) for r in rows}
+    assert by_kind[W.EV_ELECTION] == W.SEV_INFO
+    assert by_kind[W.EV_CLIENT_FAILOVER] == W.SEV_WARN
+
+
+def test_journal_disabled_records_nothing():
+    j = W.EventJournal(enabled=False)
+    j.record(W.EV_FATAL, subject=0)
+    assert j.events_total() == 0
+    assert j.collect()["events"] == []
+
+
+def test_align_event_collections_cross_process_anchors():
+    """Two processes whose monotonic clocks disagree by a known skew:
+    after alignment their events land on one timeline in true order
+    (the paxtrace anchor math, applied to the mono column)."""
+    skew = 5_000_000_000  # process B's mono clock runs 5 s behind
+    wall0 = 1_700_000_000_000_000_000
+    a = {"anchor": {"mono_ns": 100, "wall_ns": wall0},
+         "events": [[50, wall0 - 50, W.EV_ELECTION, 0, 0, 0, 0, 0]]}
+    b = {"anchor": {"mono_ns": 100 - skew, "wall_ns": wall0},
+         "events": [[75 - skew, wall0 - 25, W.EV_CHAOS_INSTALL, 1, 1,
+                     0, 0, 0]]}
+    rows = W.align_event_collections([a, b])
+    assert rows.shape[0] == 2
+    # B's event (25 ns before the anchor) lands AFTER A's (50 ns
+    # before), in A's monotonic domain
+    assert rows[0, W.EV_KIND] == W.EV_ELECTION
+    assert rows[1, W.EV_KIND] == W.EV_CHAOS_INSTALL
+    assert rows[1, W.EV_MONO] - rows[0, W.EV_MONO] == 25
+
+
+# ------------------------------------------------------- schema v6
+
+
+def test_schema_v6_watch_pid_pinned_both_directions():
+    j = W.EventJournal(capacity=16)
+    j.record(W.EV_LEADER_CHANGE, subject=1, aux=0)
+    j.record(W.EV_ALARM, subject=0, value=900, aux=W.DET_STALL)
+    events = W.event_chrome_events(j.snapshot(), tid=0)
+    assert events and all(e["pid"] == WATCH_PID for e in events)
+    assert events[1]["name"] == "alarm:frontier_stall"
+    assert all(e["ph"] == "i" and e["cat"] == "paxwatch"
+               for e in events)
+    # merged with recorder ticks: valid
+    rec = FlightRecorder(8)
+    rec.record(10_000, 0, 1, 4, 4, 10, 0, 1, 2, 3, 0, 4, 5, 6, 9_000)
+    merged = chrome_trace(rec.to_events(pid=0) + events)
+    assert validate_chrome_trace(merged) == []
+    # a paxwatch event off the reserved pid fails
+    bad = chrome_trace([dict(events[0], pid=3)])
+    assert any("paxwatch" in e for e in validate_chrome_trace(bad))
+    # a non-watch event squatting on the reserved pid fails
+    squat = chrome_trace([{"name": "tick", "cat": "tick", "ph": "X",
+                           "ts": 1.0, "dur": 1.0, "pid": WATCH_PID,
+                           "tid": 0}])
+    assert any(str(WATCH_PID) in e for e in validate_chrome_trace(squat))
+
+
+# ------------------------------------------------- synthetic series
+
+
+def _resp(tip_by_rid: dict, leader=0, proposals=0, elections=None,
+          executed=None, hist=None):
+    """A master stats fan-out response for one sample instant."""
+    replicas = []
+    for rid, fr in tip_by_rid.items():
+        cnt = {"proposals": proposals if rid == leader else 0,
+               "elections": (elections or {}).get(rid, 0)}
+        mx = {"counters": cnt, "gauges": {}}
+        if hist is not None:
+            mx["histograms"] = {"tick_wall_ms": hist[rid]}
+        replicas.append({
+            "id": rid, "ok": True, "frontier": fr,
+            "executed": (executed or {}).get(rid, fr),
+            "metrics": mx})
+    return {"ok": True, "leader": leader, "replicas": replicas}
+
+
+def _series(resps, dt=0.25, slo_ms=None):
+    return [W.flatten_cluster_stats(r, slo_ms=slo_ms, t_wall=i * dt)
+            for i, r in enumerate(resps)]
+
+
+def test_stall_fires_and_boundary():
+    """Flat tip + in-flight load for >= stall_s fires; the same series
+    one sample short of the window, or with the tip moving just past
+    the slack, does not."""
+    # leader committed up to 100 then froze; 64 admitted-but-uncommitted
+    frozen = _resp({0: 100, 1: 100, 2: 100}, proposals=165)
+    samples = _series([frozen] * 6)  # 1.25 s of flatness
+    a = W.stall_alarm(samples, stall_s=1.0, slack_slots=8)
+    assert a is not None and a["detector"] == "frontier_stall"
+    assert a["evidence"]["in_flight"] == 64
+    # window one sample short of stall_s: no fire
+    assert W.stall_alarm(samples[:4], stall_s=1.0) is None
+    # tip crawling exactly at the slack boundary: slack+1 advance over
+    # the window = not a stall
+    crawl = [_resp({0: 100 + 3 * i, 1: 100 + 3 * i, 2: 100 + 3 * i},
+                   proposals=200) for i in range(6)]
+    assert W.stall_alarm(_series(crawl), stall_s=1.0,
+                         slack_slots=8) is None
+    # no in-flight load and no arrivals: a quiet cluster is not stalled
+    quiet = _resp({0: 100, 1: 100, 2: 100}, proposals=90)
+    assert W.stall_alarm(_series([quiet] * 6), stall_s=1.0) is None
+
+
+def test_stall_attribution_minority_vs_majority():
+    # one laggard follower (minority): blame it
+    lag1 = _resp({0: 500, 1: 500, 2: 380}, proposals=600)
+    a = W.stall_alarm(_series([lag1] * 6), stall_s=1.0)
+    assert a["subject"] == 2 and "lags the tip" in a["evidence"]["why"]
+    # both followers starved together (majority): blame the leader —
+    # the isolated-leader signature (each follower one in-flight batch
+    # behind when the piggyback stream stopped)
+    maj = _resp({0: 500, 1: 436, 2: 436}, proposals=600)
+    a = W.stall_alarm(_series([maj] * 6), stall_s=1.0)
+    assert a["subject"] == 0
+    assert "leader is cut off" in a["evidence"]["why"]
+    # every frontier flat and LEVEL: still the leader
+    lvl = _resp({0: 500, 1: 500, 2: 500}, proposals=600)
+    a = W.stall_alarm(_series([lvl] * 6), stall_s=1.0)
+    assert a["subject"] == 0
+
+
+def test_churn_budget_boundary():
+    def at(n_elections):
+        resps = [_resp({0: 10 * i, 1: 10 * i, 2: 10 * i},
+                       elections={1: 0}) for i in range(9)]
+        # elections ramp linearly to n_elections on replica 1
+        for i, r in enumerate(resps):
+            r["replicas"][1]["metrics"]["counters"]["elections"] = \
+                round(n_elections * i / 8)
+        return _series(resps, dt=0.5)  # 4 s window
+
+    assert W.churn_alarm(at(3), window_s=3.0, budget=3) is None
+    a = W.churn_alarm(at(6), window_s=3.0, budget=3)
+    assert a is not None and a["subject"] == 1
+    assert a["evidence"]["elections"] > 3
+
+
+def test_backlog_growth_slope():
+    # backlog on replica 2 grows 500 slots/s; frontiers keep moving so
+    # the stall detector stays quiet but execution is drowning
+    resps = [_resp({0: 1000 + 200 * i, 1: 1000 + 200 * i,
+                    2: 1000 + 200 * i},
+                   executed={2: 1000 + 75 * i}) for i in range(9)]
+    s = _series(resps, dt=0.5)
+    a = W.backlog_alarm(s, window_s=3.0, slope_per_s=200.0,
+                        min_backlog=64)
+    assert a is not None and a["subject"] == 2
+    assert a["evidence"]["slope_per_s"] > 200
+    # flat backlog: quiet
+    flat = [_resp({0: 1000, 1: 1000, 2: 1000},
+                  executed={2: 900}) for _ in range(9)]
+    assert W.backlog_alarm(_series(flat, dt=0.5), window_s=3.0,
+                           slope_per_s=200.0) is None
+
+
+def test_burn_rate_math():
+    """bad/total over the window divided by the budget: 200 of 1000
+    ticks over the SLO against a 1% budget = burn 20x (alarm at 10x);
+    5 of 1000 = 0.5x (quiet). The histogram derivation counts a
+    bucket as bad only when its LOWER edge clears the SLO."""
+    bounds = [1.0, 10.0, 50.0, 100.0]
+
+    def hist(total, bad):
+        # counts: [<=1, (1,10], (10,50], (50,100], >100]; SLO 50 ->
+        # bad buckets are (50,100] and >100
+        return {"bounds": bounds,
+                "counts": [0, total - bad, 0, bad, 0],
+                "count": total}
+
+    def series(bad_per_k):
+        resps = []
+        for i in range(9):
+            h = {rid: hist(1000 * i // 8, bad_per_k * i // 8)
+                 for rid in range(3)}
+            resps.append(_resp({0: 10 * i, 1: 10 * i, 2: 10 * i},
+                               hist=h))
+        return _series(resps, dt=0.5, slo_ms=50.0)
+
+    a = W.burn_alarm(series(200), window_s=3.0, slo_ms=50.0,
+                     budget_frac=0.01, burn_x=10.0, min_ticks=50)
+    assert a is not None
+    assert abs(a["evidence"]["bad_frac"] - 0.2) < 0.02
+    assert a["evidence"]["burn"] >= 15
+    assert W.burn_alarm(series(5), window_s=3.0, slo_ms=50.0,
+                        budget_frac=0.01, burn_x=10.0,
+                        min_ticks=50) is None
+    # under min_ticks: no verdict from a starved histogram
+    assert W.burn_alarm(series(200)[:2], window_s=0.4, slo_ms=50.0,
+                        min_ticks=5000) is None
+
+
+def test_hist_bad_lower_edge_is_conservative():
+    h = {"bounds": [1.0, 10.0, 50.0], "counts": [1, 2, 4, 8],
+         "count": 15}
+    r = _resp({0: 5}, hist={0: h})
+    s = W.flatten_cluster_stats(r, slo_ms=10.0)
+    # bad = buckets with lower edge >= 10: (10,50] (4) + >50 (8)
+    assert s["hist_bad"] == 12 and s["hist_total"] == 15
+
+
+# ------------------------------------------------------ watcher edge
+
+
+def test_health_watcher_raise_and_clear_journaled():
+    frozen = _resp({0: 100, 1: 100, 2: 100}, proposals=165)
+    moving = [_resp({0: 100 + 50 * i, 1: 100 + 50 * i, 2: 100 + 50 * i},
+                    proposals=165) for i in range(20)]
+    w = W.HealthWatcher(slo=W.SLO(stall_s=1.0))
+    t = 0.0
+    for _ in range(6):  # freeze long enough to raise
+        w.poll_once(frozen, t_wall=t)
+        t += 0.25
+    assert len(w.alarms) == 1
+    assert w.alarms[0]["detector"] == "frontier_stall"
+    assert w.alarms[0]["t_cleared"] is None
+    for r in moving:  # heal: tip advances, alarm clears
+        w.poll_once(r, t_wall=t)
+        t += 0.25
+    assert w.alarms[0]["t_cleared"] is not None
+    # raise + clear journaled with the detector id in aux
+    rows = w.journal.snapshot()
+    kinds = rows[:, W.EV_KIND].tolist()
+    assert kinds == [W.EV_ALARM, W.EV_ALARM_CLEAR]
+    assert all(int(r[W.EV_AUX]) == W.DET_STALL for r in rows)
+    s = w.summary()
+    assert s["alarm_counts"] == {"frontier_stall": 1}
+    assert s["events"] == {"alarm": 1, "alarm_clear": 1}
+
+
+# -------------------------------------------------------- retention
+
+
+def test_health_series_week_long_run_stays_bounded(tmp_path):
+    """Simulated long run: ~2 days of 1 Hz samples (compressed into
+    one loop) against a 256 KB bound — the file must stay near the
+    bound via compaction, the coarse tiers must cover the whole span,
+    and the percentiles must be exact over a known bucket."""
+    path = tmp_path / "watch.jsonl"
+    hs = W.HealthSeries(str(path), raw_keep_s=60.0, coarse_s=30.0,
+                        max_bytes=256 << 10, max_coarse=64)
+    n = 180_000  # 50 h at 1 Hz
+    for i in range(n):
+        hs.append({"t": float(i), "tip": i * 3, "in_flight": i % 7,
+                   "replicas": {"0": {"backlog": i % 11}}})
+    hs.close()
+    size = path.stat().st_size
+    assert size < (256 << 10) * 1.25, size  # bounded (one append tail)
+    assert hs.appended == n
+    # raw recent retained at full resolution
+    assert len(hs._raw) >= 59
+    assert hs._raw[-1][0] == float(n - 1)
+    # coarse history covers (almost) the whole span, bucket count
+    # bounded by the pairwise merge
+    assert len(hs.coarse) <= 64
+    assert hs.coarse[0]["t0"] == 0.0
+    assert hs.summary()["span_s"] >= n - 120
+    # reload after an explicit compaction: the rewritten file
+    # round-trips exactly (between compactions the append-only log
+    # legitimately retains already-folded raw lines)
+    hs.compact()
+    hs.close()
+    doc = W.load_series(str(path))
+    assert len(doc["raw"]) == len(hs._raw)
+    assert len(doc["coarse"]) == len(hs.coarse)
+    assert doc["raw"][-1]["tip"] == (n - 1) * 3
+
+
+def test_health_series_coarse_percentiles_exact(tmp_path):
+    hs = W.HealthSeries(str(tmp_path / "s.jsonl"), raw_keep_s=10.0,
+                        coarse_s=100.0)
+    vals = list(range(100))
+    for i in vals:
+        hs.append({"t": float(i), "x": float(i)})
+    hs.append({"t": 1000.0, "x": 0.0})  # expire the first bucket
+    hs.close()
+    assert hs.coarse, "no coarse bucket closed"
+    st = hs.coarse[0]["stats"]["x"]
+    arr = sorted(vals[:st["n"]])
+    assert st["max"] == arr[-1]
+    assert st["p50"] == arr[min(int(0.50 * len(arr)), len(arr) - 1)]
+    assert st["p99"] == arr[min(int(0.99 * len(arr)), len(arr) - 1)]
+
+
+# --------------------------------------------- paxtop stable schema
+
+
+def _load_paxtop():
+    spec = importlib.util.spec_from_file_location(
+        "paxtop_mod", REPO / "tools" / "paxtop.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_paxtop_json_schema_keys_pinned():
+    """The --once --json document is a STABLE schema: response /
+    derived / events / health at the top, the derived-row and
+    event-row key sets exactly as published (OBSERVABILITY.md).
+    Additions are fine; this test catches removals/renames."""
+    paxtop = _load_paxtop()
+    j = W.EventJournal(capacity=16)
+    j.record(W.EV_CHAOS_INSTALL, subject=0, value=7)
+    j.record(W.EV_ALARM, subject=0, value=900, aux=W.DET_STALL)
+    resp = {"ok": True, "leader": 0, "alive": [True], "n": 1,
+            "replicas": [{
+                "id": 0, "ok": True, "protocol": "minpaxos",
+                "frontier": 42, "executed": 40,
+                "metrics": {"counters": {"dispatches": 10,
+                                         "full_steps": 10},
+                            "gauges": {"committed": 43},
+                            "histograms": {"tick_wall_ms":
+                                           {"p50": 0.5, "p99": 2.0}}},
+                "scalars": {"executed": 40}}]}
+    ev_resp = {"ok": True, "replicas": [
+        {"id": 0, "ok": True, "journal": j.collect()}]}
+    payload = paxtop.snapshot_payload(resp, ev_resp, None, 0.0,
+                                      now_wall_ns=time.time_ns())
+    assert set(paxtop.JSON_PAYLOAD_KEYS) == set(payload)
+    row = payload["derived"][0]
+    assert set(paxtop.DERIVED_ROW_KEYS) == set(row), \
+        sorted(set(paxtop.DERIVED_ROW_KEYS) ^ set(row))
+    assert len(payload["events"]) == 2
+    for ev in payload["events"]:
+        assert set(paxtop.EVENT_ROW_KEYS) == set(ev), sorted(ev)
+    # HEALTH: the newest WARN+ event (the alarm) is the stanza
+    assert payload["health"]["0"]["kind"] == "alarm:frontier_stall"
+    assert row["health"]["severity"] == "alert"
+    # serializes (the shipped tool prints it as one JSON line)
+    json.dumps(payload)
+
+
+def test_paxtop_health_ignores_info_events():
+    paxtop = _load_paxtop()
+    j = W.EventJournal(capacity=16)
+    j.record(W.EV_ELECTION, subject=0)  # info: not a health stanza
+    ev_resp = {"ok": True, "replicas": [
+        {"id": 0, "ok": True, "journal": j.collect()}]}
+    events = paxtop._derive_events(ev_resp, time.time_ns())
+    assert paxtop._derive_health(events) == {}
+
+
+def test_paxtop_health_survives_info_event_storm():
+    """An active alert must not vanish from HEALTH just because newer
+    info events pushed it past the 64-row display tail."""
+    paxtop = _load_paxtop()
+    j = W.EventJournal(capacity=256)
+    j.record(W.EV_STORE_CORRUPT, subject=0, value=3)  # the alert
+    for q in range(100):  # churn wave of info events after it
+        j.record(W.EV_PEER_UP, subject=q % 3)
+    ev_resp = {"ok": True, "replicas": [
+        {"id": 0, "ok": True, "journal": j.collect()}]}
+    resp = {"ok": True, "leader": 0, "replicas": [
+        {"id": 0, "ok": True, "frontier": 1, "executed": 1,
+         "metrics": {"counters": {}, "gauges": {}}}]}
+    payload = paxtop.snapshot_payload(resp, ev_resp, None, 0.0,
+                                      now_wall_ns=time.time_ns())
+    assert len(payload["events"]) == 64  # pane tail stays bounded
+    assert payload["health"]["0"]["kind"] == "store_corrupt"
+
+
+def test_burn_alarm_slo_above_histogram_range():
+    """An SLO declared above the histogram's top edge: over-SLO ticks
+    can only land in the overflow bucket, which must count BAD — the
+    burn detector must not go blind exactly there."""
+    h = {"bounds": [1.0, 10.0, 50.0], "counts": [0, 800, 0, 200],
+         "count": 1000}
+    s = W.flatten_cluster_stats(_resp({0: 5}, hist={0: h}),
+                                slo_ms=6000.0)
+    assert s["hist_bad"] == 200 and s["hist_total"] == 1000
+
+
+# --------------------------------------------------- campaign math
+
+
+def test_stall_verdict_window_join():
+    """_stall_verdict joins the watcher's wall-clock alarms against
+    the fired chaos events' wall marks (the CHAOS.json ground-truth
+    timeline satellite)."""
+    from minpaxos_tpu.chaos.campaign import _stall_verdict
+
+    class FakeWatcher:
+        alarms = [{"detector": "frontier_stall", "subject": 0,
+                   "t_raised": 105.0, "t_cleared": 108.2,
+                   "evidence": {"why": "x"}}]
+
+    marks = [(5.0, 104.0, "install"), (9.0, 108.0, "clear")]
+    v = _stall_verdict(FakeWatcher(), marks, expected_subject=0)
+    assert v["fired_in_window"] and v["attributed"] and v["cleared"]
+    # raised before the install: not the injected fault's detection
+    FakeWatcher.alarms = [dict(FakeWatcher.alarms[0], t_raised=90.0)]
+    v = _stall_verdict(FakeWatcher(), marks, expected_subject=0)
+    assert not v["fired_in_window"]
+    # wrong subject: detected but misattributed
+    FakeWatcher.alarms = [dict(FakeWatcher.alarms[0], t_raised=105.0,
+                               subject=2)]
+    v = _stall_verdict(FakeWatcher(), marks, expected_subject=0)
+    assert v["fired_in_window"] and not v["attributed"]

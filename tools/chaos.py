@@ -120,7 +120,11 @@ def main(argv=None) -> int:
             "failed": [
                 {"schedule": r.get("schedule"), "seed": r.get("seed"),
                  "error": r.get("error"),
-                 "violations": r.get("check", {}).get("violations")}
+                 "violations": r.get("check", {}).get("violations"),
+                 # paxwatch live verdict: a stall schedule can now
+                 # fail on detection alone (fired/attributed/cleared)
+                 # even with every offline invariant green
+                 "stall_live": (r.get("watch") or {}).get("stall")}
                 for r in verdict["runs"] if not r.get("ok")],
             "wall_s": verdict["wall_s"]}
     print(f"[chaos] verdict: {json.dumps(line)}", flush=True)

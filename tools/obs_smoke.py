@@ -71,8 +71,22 @@ from minpaxos_tpu.obs.trace import (  # noqa: E402
     analyze_collections,
     span_events,
 )
+from minpaxos_tpu.obs.watch import (  # noqa: E402
+    EV_ALARM,
+    EV_CHAOS_INSTALL,
+    EV_CLIENT_FAILOVER,
+    EV_ELECTION,
+    EV_LEADER_CHANGE,
+    EV_NARROW_FALLBACK,
+    EV_STORE_CORRUPT,
+    DET_STALL,
+    EventJournal,
+    align_event_collections,
+    event_chrome_events,
+)
 from minpaxos_tpu.runtime.master import (  # noqa: E402
     Master,
+    cluster_events,
     cluster_stats,
     cluster_trace,
     register_with_master,
@@ -181,6 +195,62 @@ def trace_overhead_guard() -> bool:
     return ok
 
 
+#: paxwatch journal budget (seconds/event): the journal is default-ON
+#: in the runtime, but its events are RARE (elections, failovers,
+#: fault installs — not per-tick), so the bound is tighter than the
+#: recorder's: one ring write + two clock reads must stay under 5 us.
+JOURNAL_BOUND_S = 5e-6
+
+
+def journal_overhead_guard() -> bool:
+    """paxwatch event-journal cost: one journal.record (tls ring
+    lookup + two clock reads + one slice assign) measured against the
+    same loop without it — the ISSUE-13 <=5 us/event contract."""
+    j = EventJournal(capacity=4096)
+
+    x = 1.0
+    for i in range(2000):  # warm allocator/bytecode + the tls ring
+        x = _tick_body(x)
+        j.record(EV_ELECTION, subject=0, value=i)
+
+    x = 1.0
+    t0 = time.perf_counter()
+    for _ in range(N_ITERS):
+        x = _tick_body(x)
+    base_s = time.perf_counter() - t0
+
+    x = 1.0
+    t0 = time.perf_counter()
+    for i in range(N_ITERS):
+        x = _tick_body(x)
+        j.record(EV_ELECTION, subject=0, value=i)
+    inst_s = time.perf_counter() - t0
+
+    per_event = (inst_s - base_s) / N_ITERS
+    ok = per_event < JOURNAL_BOUND_S
+    print(f"[obs_smoke] paxwatch journal overhead: "
+          f"{per_event * 1e6:.2f} us/event over {N_ITERS} events "
+          f"(bound {JOURNAL_BOUND_S * 1e6:.0f} us) — "
+          f"{'ok' if ok else 'FAIL'}", flush=True)
+    assert j.events_total() == N_ITERS + 2000, \
+        "guard loop did not journal"
+    return ok
+
+
+def _seed_journal() -> EventJournal:
+    """A journal holding one of each loud-path event, as a live
+    replica's EVENTS verb would serve them."""
+    j = EventJournal(capacity=256)
+    j.record(EV_ELECTION, subject=0, value=-1)
+    j.record(EV_LEADER_CHANGE, subject=0, value=0, aux=-1)
+    j.record(EV_CHAOS_INSTALL, subject=0, value=1234)
+    j.record(EV_NARROW_FALLBACK, subject=0, value=1)
+    j.record(EV_STORE_CORRUPT, subject=0, value=3)
+    j.record(EV_CLIENT_FAILOVER, subject=2, value=1)
+    j.record(EV_ALARM, subject=0, value=900, aux=DET_STALL)
+    return j
+
+
 def _seed_trace_sink() -> TraceSink:
     """A sink holding complete span chains for 8 commands, as a live
     replica's TRACESPANS verb would serve them (cluster-side stages;
@@ -237,9 +307,10 @@ def _seed_replica_obs() -> tuple[MetricsRegistry, FlightRecorder]:
 
 
 def _fake_replica_control(ctl_sock: socket.socket, reg, rec,
-                          stop: threading.Event, sink=None) -> None:
-    """Answer ping/stats/trace/tracespans on a control socket exactly
-    like runtime/replica.py's control plane (JSON lines)."""
+                          stop: threading.Event, sink=None,
+                          journal=None) -> None:
+    """Answer ping/stats/trace/tracespans/events on a control socket
+    exactly like runtime/replica.py's control plane (JSON lines)."""
     def serve(conn):
         f = conn.makefile("rw")
         try:
@@ -248,6 +319,9 @@ def _fake_replica_control(ctl_sock: socket.socket, reg, rec,
                 m = req.get("m")
                 if m == "tracespans" and sink is not None:
                     resp = {"ok": True, "id": 0, "trace": sink.collect()}
+                elif m == "events" and journal is not None:
+                    resp = {"ok": True, "id": 0,
+                            "journal": journal.collect()}
                 elif m == "ping":
                     resp = {"ok": True, "frontier": 123, "leader": 0,
                             "stats": reg.counters(), "fatal": None}
@@ -260,9 +334,13 @@ def _fake_replica_control(ctl_sock: socket.socket, reg, rec,
                             "scalars": {"executed": 121}, "fatal": None}
                 elif m == "trace":
                     last = req.get("last")
+                    evs = rec.to_events(
+                        pid=0, last=int(last) if last else None)
+                    if journal is not None:
+                        evs += event_chrome_events(journal.snapshot(),
+                                                   tid=0)
                     resp = {"ok": True, "id": 0, "recorder": True,
-                            "events": rec.to_events(
-                                pid=0, last=int(last) if last else None)}
+                            "events": evs}
                 else:
                     resp = {"ok": False, "error": f"unknown {m}"}
                 f.write(json.dumps(resp) + "\n")
@@ -292,17 +370,20 @@ def paxtop_smoke() -> bool:
     master.start()
     reg, rec = _seed_replica_obs()
     sink = _seed_trace_sink()
+    journal = _seed_journal()
     # the runtime registers these fn-gauges in ReplicaServer.__init__;
     # paxtop's TRACE column reads them out of the stats snapshot
     reg.fn_gauge("trace_spans", sink.spans_total)
     reg.fn_gauge("trace_dropped", sink.spans_dropped)
+    reg.fn_gauge("events", journal.events_total)
     ctl = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     ctl.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     ctl.bind(("127.0.0.1", dport + CONTROL_OFFSET))
     ctl.listen(8)
     stop = threading.Event()
     threading.Thread(target=_fake_replica_control,
-                     args=(ctl, reg, rec, stop, sink), daemon=True).start()
+                     args=(ctl, reg, rec, stop, sink, journal),
+                     daemon=True).start()
     ok = True
     try:
         register_with_master(("127.0.0.1", mport), "127.0.0.1", dport,
@@ -343,8 +424,49 @@ def paxtop_smoke() -> bool:
         assert row["ok"] and row["dispatches"] == 30, row
         assert abs(sum(row["mix_pct"].values()) - 100.0) < 1e-6, row
         assert row["trace_spans"] == sink.spans_total(), row
-        print("[obs_smoke] paxtop --once --json + trace fan-out: ok",
-              flush=True)
+        # paxwatch panes in the same snapshot: the EVENTS tail and the
+        # HEALTH column (newest WARN-or-worse event per replica — the
+        # seeded journal ends on an alarm)
+        assert {"response", "derived", "events", "health"} <= \
+            set(payload), sorted(payload)
+        assert len(payload["events"]) == journal.events_total()
+        assert payload["events"][-1]["kind"] == "alarm:frontier_stall"
+        assert row["health"]["kind"] == "alarm:frontier_stall", row
+        print("[obs_smoke] paxtop --once --json + trace fan-out + "
+              "EVENTS/HEALTH panes: ok", flush=True)
+
+        # paxwatch EVENTS fan-out leg: the master verb, anchor-aligned
+        # merge, and the schema-v6 instant events validating alongside
+        # the recorder ticks (reserved-pid contract both directions)
+        ev = cluster_events(("127.0.0.1", mport))
+        assert ev["ok"] and ev["replicas"][0]["ok"], ev
+        jrn = ev["replicas"][0]["journal"]
+        assert jrn["total"] == journal.events_total(), jrn["total"]
+        rows_aligned = align_event_collections([jrn])
+        merged = chrome_trace(rec.to_events(pid=0)
+                              + event_chrome_events(rows_aligned))
+        errs = validate_chrome_trace(merged)
+        assert not errs, errs[:5]
+        tr2 = cluster_trace(("127.0.0.1", mport), last=64)
+        watch_evs = [e for e in tr2["trace"]["traceEvents"]
+                     if e.get("cat") == "paxwatch"]
+        assert len(watch_evs) == journal.events_total(), len(watch_evs)
+        assert validate_chrome_trace(tr2["trace"]) == []
+        print("[obs_smoke] cluster_events fan-out + merged v6 event "
+              "track: ok", flush=True)
+
+        # the shipped watcher, as a real subprocess against the same
+        # stub cluster: one sample + detector evaluation + event counts
+        out = subprocess.run(
+            [sys.executable, str(REPO / "tools/paxwatch.py"),
+             "-mport", str(mport), "--once", "--json"],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        w = json.loads(out.stdout)
+        assert {"sample", "alarms", "events", "slo"} <= set(w), sorted(w)
+        assert w["sample"]["alive"] == 1 and w["sample"]["tip"] == 123, w
+        assert w["events"].get("alarm") == 1, w["events"]
+        print("[obs_smoke] paxwatch --once --json: ok", flush=True)
 
         # paxtrace leg: tools/tail.py --once --json (a real
         # subprocess, no JAX import there either) through the master's
@@ -368,20 +490,22 @@ def paxtop_smoke() -> bool:
         assert not errs, errs[:5]
         assert table2["n_traced"] == 8
 
-        # the paxtop contract, pinned hard: importing tail.py's whole
-        # module graph must not pull in JAX (a transitive jax import
-        # would make every tail/paxtop invocation pay backend init)
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import sys, runpy; "
-             f"runpy.run_path({str(REPO / 'tools/tail.py')!r}, "
-             "run_name='probe'); "
-             "assert 'jax' not in sys.modules, "
-             "'jax leaked onto the tail.py import path'"],
-            capture_output=True, text=True, timeout=60)
-        assert probe.returncode == 0, probe.stderr
-        print("[obs_smoke] tail --once --json + merged v5 command-span "
-              "trace + no-jax import pin: ok", flush=True)
+        # the paxtop contract, pinned hard: importing tail.py's (and
+        # paxwatch.py's) whole module graph must not pull in JAX (a
+        # transitive jax import would make every invocation pay
+        # backend init — paxwatch is meant to sit on week-long runs)
+        for tool in ("tools/tail.py", "tools/paxwatch.py"):
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import sys, runpy; "
+                 f"runpy.run_path({str(REPO / tool)!r}, "
+                 "run_name='probe'); "
+                 "assert 'jax' not in sys.modules, "
+                 f"'jax leaked onto the {tool} import path'"],
+                capture_output=True, text=True, timeout=60)
+            assert probe.returncode == 0, (tool, probe.stderr)
+        print("[obs_smoke] tail --once --json + merged command-span "
+              "trace + no-jax import pins: ok", flush=True)
     except AssertionError as e:
         print(f"[obs_smoke] paxtop smoke FAILED: {e}", file=sys.stderr,
               flush=True)
@@ -511,6 +635,7 @@ def main() -> int:
         return 0 if resident_telemetry_smoke() else 1
     ok = overhead_guard()
     ok = trace_overhead_guard() and ok
+    ok = journal_overhead_guard() and ok
     ok = paxtop_smoke() and ok
     return 0 if ok else 1
 

@@ -10,7 +10,14 @@ backlog, paxchaos injected-fault totals and narrow-anchor fallbacks
 (a running chaos campaign or a flapping narrow view is visible
 without a trace dump), the paxtrace TRACE column (sampled spans
 collected / ring-overwrite drops — whether tools/tail.py has data to
-attribute), and p50/p99 tick wall from the typed histogram.
+attribute), p50/p99 tick wall from the typed histogram, and the
+paxwatch HEALTH column (the newest WARN-or-worse journal event per
+replica + its age). Below the table, an EVENTS tail pane shows the
+newest cluster journal events (elections, leader changes, chaos
+installs, store-corruption recoveries, alarms) from the master's
+``events`` fan-out. ``--once --json`` emits the whole model —
+response / derived / events / health — under the stable key schema
+pinned in tests/test_paxwatch.py (OBSERVABILITY.md documents it).
 
     python tools/paxtop.py -mport 7087              # live, 1s refresh
     python tools/paxtop.py -mport 7087 -i 0.5       # faster refresh
@@ -39,12 +46,112 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from minpaxos_tpu.obs.recorder import validate_chrome_trace  # noqa: E402
+from minpaxos_tpu.obs.watch import (  # noqa: E402
+    DETECTOR_NAMES,
+    EV_ALARM,
+    EV_ALARM_CLEAR,
+    EV_AUX,
+    EV_KIND,
+    EV_SEV,
+    EV_SUBJECT,
+    EV_TRACE,
+    EV_VALUE,
+    EV_WALL,
+    EVENT_NAMES,
+    SEV_NAMES,
+    SEV_WARN,
+)
 from minpaxos_tpu.runtime.master import (  # noqa: E402
+    cluster_events,
     cluster_stats,
     cluster_trace,
 )
 
 _REGIMES = ("full_steps", "fused_dispatches", "narrow_steps")
+
+#: --once --json payload keys — a STABLE schema (pinned by
+#: tests/test_paxwatch.py; OBSERVABILITY.md documents it). Consumers
+#: may rely on these being present; additions are fine, removals and
+#: renames are a breaking change.
+JSON_PAYLOAD_KEYS = ("response", "derived", "events", "health")
+DERIVED_ROW_KEYS = (
+    "id", "ok", "role", "protocol", "frontier", "lag", "fatal", "error",
+    "dispatches", "ticks", "idle_skips", "committed", "chaos_injected",
+    "narrow_fallbacks", "trace_spans", "trace_dropped", "exec_backlog",
+    "mix_pct", "tick_p50_ms", "tick_p99_ms", "commits_per_s", "health")
+EVENT_ROW_KEYS = ("rid", "t_wall_s", "age_s", "kind", "severity",
+                  "subject", "value", "aux", "trace_id")
+
+
+def _derive_events(ev_resp: dict, now_wall_ns: int,
+                   last: int | None = None) -> list[dict]:
+    """Flatten an ``events`` fan-out into render rows (newest-last;
+    ``last`` bounds the tail, None = all retained), one per journal
+    event, tagged with the replica the journal belongs to."""
+    rows: list[dict] = []
+    for r in ev_resp.get("replicas", []):
+        j = r.get("journal")
+        if not r.get("ok") or not j:
+            continue
+        rid = r.get("id", -1)
+        for ev in j.get("events", []):
+            kind = int(ev[EV_KIND])
+            if kind <= 0:
+                continue
+            name = (EVENT_NAMES[kind] if kind < len(EVENT_NAMES)
+                    else str(kind))
+            if kind in (EV_ALARM, EV_ALARM_CLEAR):
+                # match the Perfetto naming: alarm events carry their
+                # detector so the pane reads "alarm:frontier_stall"
+                name = f"{name}:{DETECTOR_NAMES.get(int(ev[EV_AUX]), '?')}"
+            rows.append({
+                "rid": rid,
+                "t_wall_s": ev[EV_WALL] / 1e9,
+                "age_s": round(max(0.0,
+                                   (now_wall_ns - ev[EV_WALL]) / 1e9), 3),
+                "kind": name,
+                "severity": SEV_NAMES[min(int(ev[EV_SEV]), 2)],
+                "subject": int(ev[EV_SUBJECT]),
+                "value": int(ev[EV_VALUE]),
+                "aux": int(ev[EV_AUX]),
+                "trace_id": int(ev[EV_TRACE]),
+            })
+    rows.sort(key=lambda e: e["t_wall_s"])
+    return rows if last is None else rows[-last:]
+
+
+def _derive_health(event_rows: list[dict]) -> dict:
+    """Per-replica HEALTH: the newest WARN-or-worse journal event
+    ({rid: {kind, severity, age_s}}; absent rid = nothing loud)."""
+    out: dict[int, dict] = {}
+    for e in event_rows:  # newest-last: later rows overwrite
+        if SEV_NAMES.index(e["severity"]) >= SEV_WARN:
+            out[e["rid"]] = {"kind": e["kind"],
+                             "severity": e["severity"],
+                             "age_s": e["age_s"]}
+    return out
+
+
+def snapshot_payload(resp: dict, ev_resp: dict, prev: dict | None,
+                     dt: float, now_wall_ns: int | None = None) -> dict:
+    """The --once --json document (and the live view's model): the
+    raw stats fan-out, derived per-replica rows (with the HEALTH
+    stanza), the flattened cluster event tail, and the per-replica
+    health map. Key sets are the stable schema above."""
+    if now_wall_ns is None:
+        now_wall_ns = time.time_ns()
+    # health reads ALL retained events: an active never-cleared alert
+    # must not vanish from the HEALTH column just because 64 newer
+    # info events (a churn wave's peer_up storm) pushed it out of the
+    # display tail
+    all_events = _derive_events(ev_resp, now_wall_ns)
+    health = _derive_health(all_events)
+    rows = _derive(resp, prev, dt)
+    for row in rows:
+        row["health"] = health.get(row["id"])
+    return {"response": resp, "derived": rows,
+            "events": all_events[-64:],
+            "health": {str(k): v for k, v in health.items()}}
 
 
 def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
@@ -114,7 +221,17 @@ def _abbrev(n: int) -> str:
     return str(n)
 
 
-def _render(resp: dict, rows: list[dict], clear: bool) -> None:
+def _fmt_health(h: dict | None) -> str:
+    if not h:
+        return "-"
+    age = h["age_s"]
+    age_s = f"{age:.0f}s" if age < 600 else f"{age / 60:.0f}m"
+    return f"{h['kind']}/{age_s}"
+
+
+def _render(resp: dict, rows: list[dict], clear: bool,
+            events: list[dict] | None = None,
+            tail_n: int = 6) -> None:
     out = []
     if clear:
         out.append("\x1b[2J\x1b[H")
@@ -125,7 +242,8 @@ def _render(resp: dict, rows: list[dict], clear: bool) -> None:
     hdr = (f"{'ID':>2} {'ROLE':<8} {'ST':<2} {'FRONTIER':>9} {'LAG':>6} "
            f"{'COMMIT/S':>9} {'BACKLOG':>8} {'DISP':>8} {'FULL%':>6} "
            f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'CHAOS':>7} "
-           f"{'NARRFB':>6} {'TRACE':>11} {'p50ms':>7} {'p99ms':>8}")
+           f"{'NARRFB':>6} {'TRACE':>11} {'p50ms':>7} {'p99ms':>8} "
+           f"{'HEALTH':<18}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for r in rows:
@@ -145,7 +263,19 @@ def _render(resp: dict, rows: list[dict], clear: bool) -> None:
             f"{r['narrow_fallbacks']:>6} "
             f"{_abbrev(r['trace_spans']) + '/' + _abbrev(r['trace_dropped']):>11} "
             f"{r['tick_p50_ms']:>7.2f} "
-            f"{r['tick_p99_ms']:>8.2f}")
+            f"{r['tick_p99_ms']:>8.2f} "
+            f"{_fmt_health(r.get('health')):<18}")
+    if events:
+        # paxwatch EVENTS tail pane: the newest journal events across
+        # the cluster (elections, failovers, chaos installs, alarms)
+        out.append("")
+        out.append(f"events (newest {min(tail_n, len(events))} of "
+                   f"{len(events)} retained):")
+        for e in events[-tail_n:]:
+            when = time.strftime("%H:%M:%S", time.localtime(e["t_wall_s"]))
+            out.append(f"  {when} r{e['rid']} {e['severity']:<5} "
+                       f"{e['kind']} subject={e['subject']} "
+                       f"value={e['value']}")
     print("\n".join(out), flush=True)
 
 
@@ -205,13 +335,18 @@ def main(argv=None) -> int:
             print(f"paxtop: master unreachable at {maddr}: {e!r}",
                   file=sys.stderr)
             return 1
+        try:
+            ev_resp = cluster_events(maddr)
+        except (OSError, ValueError):
+            ev_resp = {}  # events pane degrades, stats still render
         now = time.monotonic()
-        rows = _derive(resp, prev, now - t_prev if prev else 0.0)
+        payload = snapshot_payload(resp, ev_resp, prev,
+                                   now - t_prev if prev else 0.0)
         if args.json:
-            print(json.dumps({"response": resp, "derived": rows}),
-                  flush=True)
+            print(json.dumps(payload), flush=True)
         else:
-            _render(resp, rows, clear=not args.once)
+            _render(resp, payload["derived"], clear=not args.once,
+                    events=payload["events"])
         if args.once:
             return 0
         prev, t_prev = resp, now

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""paxwatch — cluster health sampler, retention, and live SLO alarms.
+
+Polls the master's ``stats`` + ``events`` fan-outs on an interval,
+appends each health sample to an on-disk series with a streaming
+downsample (raw recent samples, p50/p99/max per coarse bucket older,
+compaction bounds the file — a week-long run stays a few MB), and
+evaluates the SLO/anomaly detectors on every poll: frontier-stall
+(with replica attribution), election-churn budget, exec-backlog
+growth, and p99 burn rate against the declared latency SLO. Alarm
+raises/clears print as parser-safe stdout lines and land in the
+tool's own event journal.
+
+    python tools/paxwatch.py -mport 7087                    # watch loop
+    python tools/paxwatch.py -mport 7087 --series w.jsonl   # + retention
+    python tools/paxwatch.py -mport 7087 --once --json      # one sample
+    python tools/paxwatch.py -mport 7087 --duration 60      # bounded run
+    python tools/paxwatch.py --report w.jsonl               # offline
+
+``--once --json`` emits one machine-readable snapshot: the flattened
+health sample, currently-firing alarms, and the cluster event journal
+counts (the stable schema OBSERVABILITY.md documents). ``--report``
+reads a saved series file back (no cluster needed) and summarizes its
+raw/coarse coverage.
+
+No JAX import anywhere on this path (the paxtop contract, pinned by
+tools/obs_smoke.py's import probe): paxwatch runs cold in
+milliseconds and is safe to leave attached to a week-long bench.
+
+Exit status: 0 = ok, 1 = cluster unreachable / bad series file;
+``--watch`` loops exit 0 on Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from minpaxos_tpu.obs.watch import (  # noqa: E402
+    SLO,
+    HealthSeries,
+    HealthWatcher,
+    align_event_collections,
+    counts_by_kind,
+    load_series,
+)
+from minpaxos_tpu.runtime.master import (  # noqa: E402
+    cluster_events,
+    cluster_stats,
+)
+
+
+def _event_counts(maddr) -> dict:
+    """{kind: count} over every replica's retained journal events."""
+    resp = cluster_events(maddr)
+    return counts_by_kind(align_event_collections(
+        [r["journal"] for r in resp.get("replicas", [])
+         if r.get("ok") and r.get("journal")]))
+
+
+def _alarm_line(verb: str, a: dict) -> str:
+    ev = a.get("evidence", {})
+    return (f"paxwatch: {verb} {a['detector']} subject=replica "
+            f"{a['subject']} window={ev.get('window_s', '?')}s "
+            f"{ev.get('why', '')}".rstrip())
+
+
+def report(path: str) -> int:
+    try:
+        doc = load_series(path)
+    except OSError as e:
+        print(f"paxwatch: cannot read {path}: {e!r}", file=sys.stderr)
+        return 1
+    raw, coarse = doc["raw"], doc["coarse"]
+    span = 0.0
+    if coarse:
+        t1 = raw[-1]["t"] if raw else coarse[-1]["t1"]
+        span = t1 - coarse[0]["t0"]
+    elif len(raw) >= 2:
+        span = raw[-1]["t"] - raw[0]["t"]
+    print(json.dumps({
+        "series": path, "raw_samples": len(raw),
+        "coarse_buckets": len(coarse), "span_s": round(span, 1),
+        "file_bytes": Path(path).stat().st_size,
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "paxwatch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("-maddr", default="127.0.0.1", help="master address")
+    p.add_argument("-mport", type=int, default=7087, help="master port")
+    p.add_argument("-i", "--interval", type=float, default=1.0,
+                   help="poll interval seconds")
+    p.add_argument("--series", default="",
+                   help="append health samples to this file "
+                        "(downsampled + compacted, bounded size)")
+    p.add_argument("--max-bytes", type=int, default=8 << 20,
+                   help="series-file compaction bound")
+    p.add_argument("--raw-keep-s", type=float, default=300.0,
+                   help="seconds of full-resolution samples retained")
+    p.add_argument("--coarse-s", type=float, default=60.0,
+                   help="downsample bucket width for older samples")
+    p.add_argument("--duration", type=float, default=0.0,
+                   help="stop after this many seconds (0 = forever)")
+    p.add_argument("--once", action="store_true",
+                   help="one sample + detector evaluation, then exit")
+    p.add_argument("--json", action="store_true",
+                   help="machine output (with --once)")
+    p.add_argument("--report", default="", metavar="FILE",
+                   help="summarize a saved series file and exit")
+    # the declared SLO + detector tuning (OBSERVABILITY.md catalogue)
+    p.add_argument("--slo-p99-ms", type=float, default=50.0,
+                   help="tick-wall latency SLO the burn rate is "
+                        "measured against")
+    p.add_argument("--burn-budget", type=float, default=0.01,
+                   help="allowed fraction of ticks over the SLO")
+    p.add_argument("--stall-s", type=float, default=1.0,
+                   help="frontier flat this long under load = stall")
+    p.add_argument("--churn-budget", type=int, default=3,
+                   help="elections allowed per churn window")
+    args = p.parse_args(argv)
+
+    if args.report:
+        return report(args.report)
+
+    maddr = (args.maddr, args.mport)
+    slo = SLO(stall_s=args.stall_s, churn_budget=args.churn_budget,
+              p99_ms=args.slo_p99_ms, burn_budget_frac=args.burn_budget)
+    series = (HealthSeries(args.series, raw_keep_s=args.raw_keep_s,
+                           coarse_s=args.coarse_s,
+                           max_bytes=args.max_bytes)
+              if args.series else None)
+    watcher = HealthWatcher(
+        poll_fn=lambda: cluster_stats(maddr, timeout_s=10.0),
+        slo=slo, series=series, interval_s=args.interval)
+
+    if args.once:
+        try:
+            active = watcher.poll_once()
+            events = _event_counts(maddr)
+        except (OSError, ValueError) as e:
+            print(f"paxwatch: master unreachable at {maddr}: {e!r}",
+                  file=sys.stderr)
+            return 1
+        sample = watcher.samples[-1]
+        if args.json:
+            print(json.dumps({"sample": sample, "alarms": active,
+                              "events": events, "slo": vars(slo)}))
+        else:
+            print(f"paxwatch: tip={sample['tip']} "
+                  f"alive={sample['alive']}/{len(sample['replicas'])} "
+                  f"leader={sample['leader']} "
+                  f"in_flight={sample['in_flight']} events={events}")
+            for a in active:
+                print(_alarm_line("ALARM", a))
+        if series is not None:
+            series.close()
+        return 0
+
+    deadline = (time.monotonic() + args.duration if args.duration > 0
+                else None)
+    seen: set[int] = set()
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            try:
+                watcher.poll_once()
+            except (OSError, ValueError) as e:
+                print(f"paxwatch: poll failed: {e!r}", file=sys.stderr)
+                time.sleep(args.interval)
+                continue
+            for i, a in enumerate(watcher.alarms):
+                if i not in seen and a["t_cleared"] is None:
+                    seen.add(i)
+                    print(_alarm_line("ALARM", a), flush=True)
+                elif a["t_cleared"] is not None and i in seen:
+                    seen.discard(i)
+                    print(_alarm_line("clear", a), flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if series is not None:
+            series.close()
+        summary = watcher.summary()
+        summary.pop("alarms", None)
+        if series is not None:
+            summary["series"] = series.summary()
+        print(f"paxwatch: {json.dumps(summary)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,60 @@ def collect_tcp_row(repo: Path = REPO) -> dict | None:
     }
 
 
+def collect_health_rows(repo: Path = REPO) -> list[dict]:
+    """paxwatch health evidence from committed artifacts: per
+    CHAOS.json campaign run the live-detector alarm counts, the
+    cluster event-journal kinds, and the stall-schedule live verdict
+    (fired-in-window / attributed / cleared); plus any PAXWATCH*.jsonl
+    retention series (raw/coarse coverage). Parsed directly — no
+    minpaxos import, same zero-dependency contract as the rest of
+    this tool."""
+    rows: list[dict] = []
+    chaos = repo / "CHAOS.json"
+    if chaos.exists():
+        try:
+            doc = json.load(open(chaos))
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"artifact": chaos.name, "error": repr(e)[:60]})
+            doc = {"runs": []}
+        for r in doc.get("runs", []):
+            w = r.get("watch") or {}
+            stall = w.get("stall") or {}
+            rows.append({
+                "artifact": chaos.name,
+                "run": f"{r.get('schedule')}@{r.get('seed')}",
+                "alarms": w.get("alarm_counts") or {},
+                "events": r.get("cluster_events") or {},
+                "stall_live": (
+                    None if not stall else
+                    f"fired={stall.get('fired_in_window')} "
+                    f"attributed={stall.get('attributed')} "
+                    f"cleared={stall.get('cleared')}"),
+                "faults": r.get("faults_injected"),
+                "ok": r.get("ok"),
+            })
+    for path in sorted(glob.glob(str(repo / "PAXWATCH*.jsonl"))):
+        raw = coarse = bad = 0
+        try:
+            for ln in open(path, encoding="utf-8"):
+                try:
+                    d = json.loads(ln)
+                except json.JSONDecodeError:
+                    bad += 1
+                    continue
+                raw += "raw" in d
+                coarse += "coarse" in d
+        except OSError as e:
+            rows.append({"artifact": os.path.basename(path),
+                         "error": repr(e)[:60]})
+            continue
+        rows.append({"artifact": os.path.basename(path),
+                     "run": "series", "raw_samples": raw,
+                     "coarse_buckets": coarse, "torn_lines": bad,
+                     "bytes": os.path.getsize(path)})
+    return rows
+
+
 def collect_progress(repo: Path = REPO) -> list[dict]:
     """Last PROGRESS.jsonl sample per driver round: commits and LoC at
     round end — the repo-growth axis the bench trajectory rides on."""
@@ -190,7 +244,13 @@ def collect_progress(repo: Path = REPO) -> list[dict]:
     ]
 
 
-def render_markdown(bench, tcp, progress) -> str:
+def _fmt_counts(d: dict | None) -> str:
+    if not d:
+        return "-"
+    return " ".join(f"{k}:{v}" for k, v in sorted(d.items()))
+
+
+def render_markdown(bench, tcp, progress, health=None) -> str:
     out = ["## Cross-PR bench trajectory (device loop)", ""]
     hdr = ("| artifact | when | platform | resident | inst/s | p50 ms "
            "| p99 ms | concurrent | shape | note |")
@@ -213,6 +273,28 @@ def render_markdown(bench, tcp, progress) -> str:
                 f"| {_fmt(tcp['ops_per_sec'])} "
                 f"| {_fmt(tcp['serial_p50_ms'], 2)} "
                 f"| {_fmt(tcp['serial_p99_ms'], 2)} |"]
+    if health:
+        out += ["", "## Cluster health (paxwatch artifacts)", "",
+                "| artifact | run | ok | alarms | stall live | faults "
+                "| events |", "|" + "---|" * 7]
+        for h in health:
+            if h.get("error"):
+                out.append(f"| {h['artifact']} | - | - | - | - | - "
+                           f"| {h['error']} |")
+            elif h.get("run") == "series":
+                out.append(
+                    f"| {h['artifact']} | series "
+                    f"| - | raw={h['raw_samples']} "
+                    f"coarse={h['coarse_buckets']} | - | - "
+                    f"| {_fmt(h['bytes'])} B |")
+            else:
+                out.append(
+                    f"| {h['artifact']} | {h['run']} "
+                    f"| {'y' if h.get('ok') else 'n'} "
+                    f"| {_fmt_counts(h.get('alarms'))} "
+                    f"| {h.get('stall_live') or '-'} "
+                    f"| {_fmt(h.get('faults'))} "
+                    f"| {_fmt_counts(h.get('events'))} |")
     if progress:
         out += ["", "## Repo growth (PROGRESS.jsonl, per driver round)", "",
                 "| round | commits | LoC | wall h |", "|" + "---|" * 4]
@@ -235,11 +317,13 @@ def main(argv=None) -> int:
     bench = collect_bench_rows(repo)
     tcp = collect_tcp_row(repo)
     progress = collect_progress(repo)
+    health = collect_health_rows(repo)
     if args.json:
         print(json.dumps({"bench": bench, "tcp": tcp,
-                          "progress": progress}, indent=1))
+                          "progress": progress, "health": health},
+                         indent=1))
     else:
-        print(render_markdown(bench, tcp, progress))
+        print(render_markdown(bench, tcp, progress, health))
     return 0
 
 
