@@ -37,6 +37,7 @@ durable store).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pathlib
@@ -94,6 +95,14 @@ RUNTIME_KNOBS = {
     # A/B (tracing off is byte-transparent on the wire).
     "trace": os.environ.get("BENCH_TCP_TRACE", "1") != "0",
     "trace_pow2": os.environ.get("BENCH_TCP_TRACEPOW2", "4"),
+    # ISSUE-15 event-driven ingress (default ON, the production
+    # shape); BENCH_TCP_COALESCE=0 / BENCH_TCP_OVERLAP=0 run the
+    # cadence-driven legs for the paired serial A/B, and main()
+    # records that pairing itself under "serial_cadence_baseline"
+    "coalesce": os.environ.get("BENCH_TCP_COALESCE", "1") != "0",
+    "coalesce_wait_us": os.environ.get("BENCH_TCP_COALESCE_WAIT_US",
+                                       "200"),
+    "overlap_exec": os.environ.get("BENCH_TCP_OVERLAP", "1") != "0",
 }
 
 
@@ -110,7 +119,25 @@ def _knob_args(keyhint: int, trace_pow2: str | None = None) -> list:
         args.append("-norecorder")
     if not RUNTIME_KNOBS["trace"]:
         args.append("-notrace")
+    args += ["-coalesce-wait-us", RUNTIME_KNOBS["coalesce_wait_us"]]
+    if not RUNTIME_KNOBS["coalesce"]:
+        args.append("-nocoalesce")
+    if not RUNTIME_KNOBS["overlap_exec"]:
+        args.append("-nooverlapexec")
     return args
+
+
+@contextlib.contextmanager
+def _knobs(**over):
+    """Temporarily override RUNTIME_KNOBS entries — the paired-A/B
+    legs flip coalesce/overlap_exec without touching the environment
+    (every record still carries the values it actually ran under)."""
+    old = {k: RUNTIME_KNOBS[k] for k in over}
+    RUNTIME_KNOBS.update(over)
+    try:
+        yield
+    finally:
+        RUNTIME_KNOBS.update(old)
 
 
 def _client_trace_pow2(serial: bool = False) -> int | None:
@@ -177,9 +204,6 @@ def _boot(proto_flag: str, env, tmp, shape) -> tuple[list, int]:
             env=env, cwd=tmp, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL))
     return procs, mport
-
-
-import contextlib
 
 
 @contextlib.contextmanager
@@ -395,6 +419,66 @@ def run_serial(proto_flag: str, label: str) -> dict:
         }
 
 
+def _lat_pcts(lats_sorted: list) -> dict:
+    """p50/p90/p99/p999/max from an already-sorted ms list (the swarm
+    leg's full-distribution report — same keys as serial_latency)."""
+
+    def _pct(q):
+        return (round(lats_sorted[min(int(len(lats_sorted) * q),
+                                      len(lats_sorted) - 1)], 3)
+                if lats_sorted else None)
+
+    return {"p50_ms": _pct(0.50), "p90_ms": _pct(0.90),
+            "p99_ms": _pct(0.99), "p999_ms": _pct(0.999),
+            "max_ms": _pct(1.0)}
+
+
+def run_swarm(proto_flag: str, label: str, sessions: int,
+              ops_per_session: int = 20,
+              timeout_s: float = 180.0) -> dict:
+    """Concurrent-client leg: ``sessions`` closed-loop TCP sessions
+    through the ingress coalescer (runtime/client.py ClientSwarm),
+    reporting the full per-command latency distribution, the paxtrace
+    stage table, and the coalescer/admission tallies. Overload is
+    expected to degrade to bounded queueing + retransmit (the
+    admission gate keyed off exec backlog and the paxwatch burn-rate
+    detector), so ``retransmits``/``rejects`` are part of the record,
+    not failures."""
+    with _cluster(proto_flag, SERVER_SHAPE) as maddr:
+        from minpaxos_tpu.runtime.client import ClientSwarm, gen_workload
+
+        _progress(f"{label}: cluster booting")
+        _warm(maddr)
+        n = sessions * ops_per_session
+        ops, keys, vals = gen_workload(n, seed=7)
+        tp2 = _client_trace_pow2()
+        _progress(f"{label}: warm; {sessions} sessions x "
+                  f"{ops_per_session} ops")
+        swarm = ClientSwarm(maddr, sessions=sessions, trace_pow2=tp2)
+        try:
+            res = swarm.run(ops, keys, vals, ops_per_session,
+                            timeout_s=timeout_s)
+            traced = ({} if tp2 is None else
+                      _traced_latency(maddr, [swarm.trace_collect()]))
+        finally:
+            swarm.close()
+        metrics_snap = _metrics_snapshot(maddr)
+        lats = res.pop("lat_ms_sorted")
+        res.update({
+            "config": label,
+            "latency": _lat_pcts(lats),
+            "traced_latency": traced,
+            "server_shape": " ".join(SERVER_SHAPE),
+            "runtime_knobs": dict(RUNTIME_KNOBS),
+            "metrics_snapshot": metrics_snap,
+        })
+        _progress(f"{label}: {res['acked']}/{res['sent']} acked, "
+                  f"p50 {res['latency']['p50_ms']} ms, "
+                  f"p99 {res['latency']['p99_ms']} ms, "
+                  f"{res['retransmits']} retransmits")
+        return res
+
+
 def main() -> None:
     q = int(os.environ.get("BENCH_TCP_Q", "20000"))
     k = int(os.environ.get("BENCH_TCP_K", "5"))
@@ -416,6 +500,31 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         rec["serial_error"] = repr(e)[:200]
     out_path.write_text(json.dumps(rec) + "\n")
+    # paired A/B (ISSUE 15): the headline serial leg above ran with
+    # the event-driven ingress ON (production knobs); this leg is the
+    # SAME shape, same host, coalescer+overlapped-exec forced OFF —
+    # the cadence-driven before. Skip with BENCH_TCP_AB=0.
+    if os.environ.get("BENCH_TCP_AB", "1") != "0":
+        try:
+            with _knobs(coalesce=False, overlap_exec=False):
+                rec["serial_cadence_baseline"] = run_serial(
+                    "-min", "bareminpaxos serial (coalesce+overlap OFF)")
+        except Exception as e:  # noqa: BLE001
+            rec["serial_cadence_baseline"] = {"error": repr(e)[:200]}
+        out_path.write_text(json.dumps(rec) + "\n")
+    # concurrent-client leg through the coalescer (BENCH_TCP_SWARM
+    # sessions; 0 skips — CI runs 64, the full bench 256, the slow
+    # suite 1024)
+    swarm_n = int(os.environ.get("BENCH_TCP_SWARM", "256"))
+    if swarm_n > 0:
+        try:
+            rec["swarm"] = run_swarm(
+                "-min", f"swarm_{swarm_n}_sessions", swarm_n,
+                ops_per_session=int(
+                    os.environ.get("BENCH_TCP_SWARM_OPS", "20")))
+        except Exception as e:  # noqa: BLE001
+            rec["swarm"] = {"error": repr(e)[:200]}
+        out_path.write_text(json.dumps(rec) + "\n")
     try:
         rec["mencius_tcp"] = run_config(
             "-m", "mencius_tcp_3rep_durable (beyond reference: its "

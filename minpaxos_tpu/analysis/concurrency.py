@@ -18,7 +18,11 @@ never looked; this pass makes the convention checkable:
   ``recv``, ``sendall``, ``connect``, ``create_connection``) or
   ``time.sleep`` while holding a lock. Failure mode: every thread that
   needs the lock stalls behind one slow peer's TCP timeout — the
-  protocol tick inherits network tail latency.
+  protocol tick inherits network tail latency. Condition variables
+  count as locks here (``_cv``/``cond`` names): the ingress
+  coalescer's wakeup cv guards the pending row list, and a socket
+  read under it would stall every client reader's enqueue.
+  ``cv.wait`` is exempt — it releases the lock while parked.
 * **donated-state read** — in ``runtime/replica.py``, any touch of
   ``self.state`` from a method reachable from a thread target OTHER
   than the protocol thread's ``_run``. ``self.state``'s arrays are
@@ -46,6 +50,7 @@ RULE = "concurrency"
 
 SCOPE_PREFIXES = ("minpaxos_tpu/runtime/transport.py",
                   "minpaxos_tpu/runtime/master.py",
+                  "minpaxos_tpu/runtime/batches.py",
                   "minpaxos_tpu/cli/")
 
 # donated-state scope: the replica runtime, whose device state is
@@ -70,11 +75,25 @@ def _is_self_attr(node: ast.expr) -> str | None:
     return None
 
 
+def _lockish(name: str) -> bool:
+    """Lock-or-condition-variable name. Condition variables ARE locks
+    for the blocking-under-lock rule: the ingress coalescer's wakeup
+    cv (``self._cv``) guards its pending list, and a blocking socket
+    read while holding it would stall every client reader's enqueue —
+    exactly the stall the poll loop never had. ``cv.wait`` itself is
+    fine (it RELEASES the lock while parked) and is not in
+    ``_BLOCKING_ATTRS``. The 'cv' match is exact-name / ``_cv`` suffix
+    on purpose: a bare substring test would swallow ``recv``."""
+    low = name.lower()
+    return ("lock" in low or "cond" in low
+            or low == "cv" or low.endswith("_cv"))
+
+
 def _is_lock_expr(node: ast.expr) -> bool:
-    """`self._lock`-ish: an attribute or name with 'lock' in it."""
+    """`self._lock`-ish or `self._cv`-ish (see ``_lockish``)."""
     if isinstance(node, ast.Attribute):
-        return "lock" in node.attr.lower()
-    return isinstance(node, ast.Name) and "lock" in node.id.lower()
+        return _lockish(node.attr)
+    return isinstance(node, ast.Name) and _lockish(node.id)
 
 
 def _with_holds_lock(node: ast.With) -> bool:
@@ -130,7 +149,7 @@ class _ClassFacts:
                 for sub in node.body:
                     for n in ast.walk(sub):
                         attr = _is_self_attr(n)
-                        if attr is not None and "lock" not in attr.lower():
+                        if attr is not None and not _lockish(attr):
                             self.guarded.add(attr)
 
     def reachable_from(self, roots: set[str]) -> set[str]:

@@ -97,6 +97,24 @@ def main(argv=None) -> None:
                         " strictly after each readback instead of"
                         " overlapping the next dispatch's device"
                         " compute) — for A/Bs")
+    p.add_argument("-nocoalesce", action="store_true",
+                   help="disable the event-driven ingress coalescer"
+                        " (client rows then land on a plain polled"
+                        " queue and a lone command pays the poll"
+                        " interval in <commit>) — for A/Bs")
+    p.add_argument("-coalesce-wait-us", type=int, default=200,
+                   help="coalescer max-wait: how long the tick loop"
+                        " lingers for more client rows once the first"
+                        " row of a batch arrives (microseconds; 0 ="
+                        " dispatch immediately)")
+    p.add_argument("-coalesce-rows", type=int, default=0,
+                   help="coalescer max-rows: dispatch as soon as this"
+                        " many client rows are pending (0 = half the"
+                        " device inbox)")
+    p.add_argument("-nooverlapexec", action="store_true",
+                   help="disable overlapped exec (committed slots then"
+                        " wait a full extra tick before executing —"
+                        " the entire <exec_wait> stage) — for A/Bs")
     p.add_argument("-narrow", type=int, default=0,
                    help="small-window specialized step: run"
                         " low-occupancy ticks through a compiled-once"
@@ -201,6 +219,10 @@ def main(argv=None) -> None:
                          idle_skip_max_s=args.idlemaxskip,
                          narrow_window=args.narrow,
                          pipeline=not args.nopipeline,
+                         coalesce=not args.nocoalesce,
+                         coalesce_wait_us=args.coalesce_wait_us,
+                         coalesce_rows=args.coalesce_rows,
+                         overlap_exec=not args.nooverlapexec,
                          key_hint=args.keyhint,
                          warm_variants=True,
                          recorder=not args.norecorder,

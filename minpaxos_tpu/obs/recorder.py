@@ -56,8 +56,13 @@ import numpy as np
 #: recoveries, narrow fallbacks, alarms) rendered as instant events
 #: under the reserved WATCH_PID, so one merged file shows WHEN the
 #: cluster's incidents happened against the tick / device-round /
-#: command-span tracks. Tick-row layout unchanged from v3.)
-SCHEMA_VERSION = 6
+#: command-span tracks. Tick-row layout unchanged from v3. v7:
+#: ingress-coalescer fields — ``coal_occ`` (client rows the
+#: event-driven ingress front batched into this tick's drain) and
+#: ``coal_wake`` (cumulative condition-variable kicks that woke a
+#: parked tick loop), appended AFTER chaos_faults so pre-v7 field
+#: indices still hold.)
+SCHEMA_VERSION = 7
 
 # dispatch regimes (runtime/replica.py classifies one per tick:
 # narrow > fused > full; idle-skip never reaches the device)
@@ -73,12 +78,13 @@ KIND_NAMES = ("full", "fused", "narrow", "idle_skip")
 # they never ran and overlap consecutive tick slices in a viewer.
 (F_T_NS, F_KIND, F_K, F_ROWS_IN, F_ROWS_OUT, F_FRONTIER, F_BACKLOG,
  F_DRAIN_US, F_ENQUEUE_US, F_READBACK_US, F_OVERLAP_US, F_PERSIST_US,
- F_DISPATCH_US, F_REPLY_US, F_T_RB_NS, F_CHAOS) = range(16)
-N_FIELDS = 16
+ F_DISPATCH_US, F_REPLY_US, F_T_RB_NS, F_CHAOS, F_COAL_OCC,
+ F_COAL_WAKE) = range(18)
+N_FIELDS = 18
 FIELD_NAMES = ("t_ns", "kind", "k", "rows_in", "rows_out", "frontier",
                "exec_backlog", "drain_us", "enqueue_us", "readback_us",
                "overlap_us", "persist_us", "dispatch_us", "reply_us",
-               "t_rb_ns", "chaos_faults")
+               "t_rb_ns", "chaos_faults", "coal_occ", "coal_wake")
 
 # dispatch-side phases, laid end-to-end ENDING at t_rb_ns (tid 0),
 # and host-side phases ending at t_ns (tid 1 — their own track, so a
@@ -231,18 +237,25 @@ class FlightRecorder:
                rows_out: int, frontier: int, backlog: int, drain_us: int,
                enqueue_us: int, readback_us: int, overlap_us: int,
                persist_us: int, dispatch_us: int, reply_us: int,
-               t_rb_ns: int = 0, chaos_faults: int = 0) -> None:
+               t_rb_ns: int = 0, chaos_faults: int = 0,
+               coal_occ: int = 0, coal_wake: int = 0) -> None:
         """``t_ns``: when the tick's host phases completed. ``t_rb_ns``:
         when its readback completed (0 = unknown; to_events then lays
         the dispatch phases contiguously before the host phases, which
         is exact for serial ticks). ``chaos_faults``: the transport's
         CUMULATIVE injected-fault total at this tick (0 when paxchaos
-        was never installed — traces without chaos are unchanged)."""
+        was never installed — traces without chaos are unchanged).
+        ``coal_occ``: client rows the ingress coalescer batched into
+        this tick's drain (0 = no coalescer / no client rows).
+        ``coal_wake``: the coalescer's CUMULATIVE wakeup-kick count at
+        this tick (schema v7; both default 0 so pre-v7 call sites are
+        unchanged)."""
         with self._lock:
             self._buf[self.total % self.capacity] = (
                 t_ns, kind, k, rows_in, rows_out, frontier, backlog,
                 drain_us, enqueue_us, readback_us, overlap_us,
-                persist_us, dispatch_us, reply_us, t_rb_ns, chaos_faults)
+                persist_us, dispatch_us, reply_us, t_rb_ns, chaos_faults,
+                coal_occ, coal_wake)
             self.total += 1
 
     def snapshot(self, last: int | None = None) -> np.ndarray:
@@ -289,7 +302,9 @@ class FlightRecorder:
                          "frontier": int(r[F_FRONTIER]),
                          "exec_backlog": int(r[F_BACKLOG]),
                          "host_us": host_dur,
-                         "overlap_us": int(r[F_OVERLAP_US])}})
+                         "overlap_us": int(r[F_OVERLAP_US]),
+                         "coal_occ": int(r[F_COAL_OCC]),
+                         "coal_wake": int(r[F_COAL_WAKE])}})
             if int(r[F_KIND]) != KIND_IDLE_SKIP:
                 t = t0
                 for name, i in _DISPATCH_PHASES:
@@ -323,6 +338,17 @@ class FlightRecorder:
                 events.append({"name": "chaos_faults", "ph": "C",
                                "ts": t_end, "pid": pid, "tid": 0,
                                "args": {"chaos_faults": int(r[F_CHAOS])}})
+            if r[F_COAL_WAKE] > 0:
+                # coalescer tracks (schema v7), emitted only once the
+                # ingress front has kicked at least one wakeup: the
+                # per-drain occupancy line shows batch formation doing
+                # its job against the tick regimes above it
+                events.append({"name": "coalesce_occupancy", "ph": "C",
+                               "ts": t_end, "pid": pid, "tid": 0,
+                               "args": {"coal_occ": int(r[F_COAL_OCC])}})
+                events.append({"name": "coalesce_wakeups", "ph": "C",
+                               "ts": t_end, "pid": pid, "tid": 0,
+                               "args": {"coal_wake": int(r[F_COAL_WAKE])}})
         return events
 
 

@@ -21,13 +21,178 @@ expansion.
 
 from __future__ import annotations
 
+import queue
+import threading
+import time
+
 import numpy as np
 
+from minpaxos_tpu.obs.metrics import MetricsRegistry
 from minpaxos_tpu.ops.packed import join_i64, split_i64
 from minpaxos_tpu.wire.messages import MsgKind, make_batch
 
 COLS = ("kind", "src", "ballot", "inst", "last_committed", "op",
         "key_hi", "key_lo", "val_hi", "val_lo", "cmd_id", "client_id")
+
+#: mirrors transport.FROM_CLIENT (transport imports nothing from here's
+#: coalescer, but keeping the literal avoids a runtime import cycle;
+#: the wire ledger pins the queue item protocol, not this module)
+_FROM_CLIENT = 1
+
+#: per-drain coalesced-row buckets for the occupancy histogram —
+#: powers of two up to the largest inbox the shape ladder drives
+COALESCE_ROW_BUCKETS: tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048)
+
+
+class IngressCoalescer:
+    """Event-driven ingress front for the protocol thread's inbox queue.
+
+    Drop-in replacement for the ``queue.Queue`` the transport's reader
+    threads feed (``put`` / ``get(timeout=...)`` / ``get_nowait`` /
+    ``empty`` / ``qsize`` — the whole surface replica.py touches),
+    injected via ``Transport(inbox_queue=...)``. Three behaviors turn
+    the cadence-driven poll loop into an event-driven one:
+
+    * **Condition-variable kick** — ``put`` notifies a blocked getter
+      immediately, so queued traffic wakes the tick loop the moment
+      rows arrive instead of riding out the poll sleep (the
+      ``work_pending`` idle fast path is untouched: an idle replica
+      still parks on the long timeout).
+    * **Batch formation (max-wait µs / max-rows)** — once the first
+      item lands, the blocking ``get`` lingers up to ``max_wait_us``
+      for more client PROPOSE rows (stopping early at ``max_rows``),
+      coalescing many small client writes into one device-sized
+      proposal batch: one dispatch amortizes its fixed cost over the
+      concurrent sessions instead of paying it per connection. A
+      linger that times out short of ``max_rows`` counts a
+      ``deadline_hit`` (the lone-serial-command case: it pays
+      ``max_wait_us``, not a poll interval). ``max_wait_us=0``
+      disables lingering entirely.
+    * **Admission control** — when ``admit_gate`` (wired by the
+      replica to the paxmon exec-backlog bound and the paxwatch
+      burn-rate detector) reports overload AND the pending client rows
+      already exceed ``max_rows``, new PROPOSE frames are dropped at
+      ingress and counted (legal: Paxos tolerates loss, clients retry
+      with the same cmd_id) — overload degrades to bounded queueing
+      instead of an unbounded tail.
+
+    Lock discipline (paxlint's concurrency pass checks it): every
+    mutation happens under the wakeup condition variable, and nothing
+    blocking — no socket ops, no sleeps — ever runs while holding it;
+    ``wait`` releases the lock by construction. Peer frames, CONTROL
+    verbs and CONN_LOST notices pass straight through in arrival
+    order; only client PROPOSE rows participate in row accounting.
+    """
+
+    def __init__(self, max_wait_us: int = 200, max_rows: int = 256,
+                 admit_gate=None, metrics: MetricsRegistry | None = None):
+        self.max_wait_us = max_wait_us
+        self.max_rows = max_rows
+        self._admit_gate = admit_gate
+        self._items: list = []
+        self._cv = threading.Condition()
+        self._pending_rows = 0  # client PROPOSE rows queued
+        self._waiting = 0       # getters currently blocked
+        self.last_occupancy = 0  # rows coalesced by the newest drain
+        self.metrics = metrics if metrics is not None else MetricsRegistry(
+            namespace="coalescer")
+        self._c_wakeups = self.metrics.counter(
+            "coalesce_wakeups", "puts that kicked a blocked tick loop "
+            "awake (the event-driven path; 0 means the loop never "
+            "slept while traffic arrived)")
+        self._c_deadline_hits = self.metrics.counter(
+            "coalesce_deadline_hits", "batch-formation lingers that "
+            "timed out at max_wait_us short of max_rows (the lone "
+            "serial command's bounded wait)")
+        self._c_rejects = self.metrics.counter(
+            "coalesce_admission_rejects", "client PROPOSE rows dropped "
+            "at ingress under overload (exec-backlog / burn-rate "
+            "gate) — clients retry with the same cmd_id")
+        self._h_batch = self.metrics.histogram(
+            "coalesce_batch_rows", "client rows coalesced per blocking "
+            "drain", bounds=COALESCE_ROW_BUCKETS)
+        self.metrics.fn_gauge("coalesce_pending_rows",
+                              lambda: self._pending_rows)
+
+    @staticmethod
+    def _client_rows(item) -> int:
+        """Row count when the item is a client PROPOSE frame, else 0."""
+        src_kind, _conn, kind, rows = item
+        if (src_kind == _FROM_CLIENT and kind == MsgKind.PROPOSE
+                and rows is not None):
+            return len(rows)
+        return 0
+
+    # -- producer side (transport reader threads, control threads) --
+
+    def put(self, item, block: bool = True,
+            timeout: float | None = None) -> None:
+        n = self._client_rows(item)
+        with self._cv:
+            if (n > 0 and self._admit_gate is not None
+                    and self._pending_rows + n > self.max_rows
+                    and self._admit_gate()):
+                self._c_rejects.inc(n)
+                return
+            self._items.append(item)
+            self._pending_rows += n
+            if self._waiting:
+                self._c_wakeups.inc()
+            self._cv.notify()
+
+    # -- consumer side (the protocol thread only) --
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        with self._cv:
+            if not self._items:
+                if not block:
+                    raise queue.Empty
+                deadline = (None if timeout is None
+                            else time.monotonic() + timeout)
+                self._waiting += 1
+                try:
+                    while not self._items:
+                        left = (None if deadline is None
+                                else deadline - time.monotonic())
+                        if left is not None and left <= 0:
+                            raise queue.Empty
+                        self._cv.wait(left)
+                finally:
+                    self._waiting -= 1
+            # batch formation: linger for more client rows, bounded by
+            # max_wait_us (deadline hit) or max_rows (early dispatch)
+            if self.max_wait_us > 0 and 0 < self._pending_rows < self.max_rows:
+                t_end = time.monotonic() + self.max_wait_us / 1e6
+                while 0 < self._pending_rows < self.max_rows:
+                    left = t_end - time.monotonic()
+                    if left <= 0:
+                        self._c_deadline_hits.inc()
+                        break
+                    self._cv.wait(left)
+            self.last_occupancy = self._pending_rows
+            if self._pending_rows > 0:
+                self._h_batch.observe(self._pending_rows)
+            return self._pop_locked()
+
+    def get_nowait(self):
+        with self._cv:
+            if not self._items:
+                raise queue.Empty
+            return self._pop_locked()
+
+    def _pop_locked(self):
+        item = self._items.pop(0)
+        self._pending_rows -= self._client_rows(item)
+        return item
+
+    def empty(self) -> bool:
+        with self._cv:
+            return not self._items
+
+    def qsize(self) -> int:
+        with self._cv:
+            return len(self._items)
 
 
 class ColumnBuffer:

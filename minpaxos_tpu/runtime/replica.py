@@ -27,6 +27,7 @@ import socket
 import sys
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 
 import jax
@@ -61,6 +62,9 @@ from minpaxos_tpu.obs.trace import (
     trace_id_for,
 )
 from minpaxos_tpu.obs.watch import (
+    DET_BURN,
+    EV_ALARM,
+    EV_ALARM_CLEAR,
     EV_CHAOS_CLEAR,
     EV_CHAOS_INSTALL,
     EV_ELECTION,
@@ -69,6 +73,7 @@ from minpaxos_tpu.obs.watch import (
     EV_NARROW_FALLBACK,
     EV_STORE_CORRUPT,
     EventJournal,
+    burn_alarm,
     event_chrome_events,
 )
 from minpaxos_tpu.ops.kvstore import LIVE
@@ -170,6 +175,8 @@ class _InflightTick:
     enqueue_us: int
     readback_us: int
     t_rb_ns: int          # monotonic_ns at readback (trace anchoring)
+    coal_occ: int = 0     # rows the ingress coalescer batched for this tick
+    coal_wake: int = 0    # cumulative coalescer wakeup kicks at this tick
 
 
 class FatalReplicaError(RuntimeError):
@@ -249,6 +256,31 @@ class RuntimeFlags:
     # reply never waits for the next wakeup. -nopipeline restores the
     # strictly serial enqueue->readback->host order for A/Bs.
     pipeline: bool = True
+    # event-driven ingress coalescer (batches.IngressCoalescer): the
+    # inbox queue the transport's reader threads feed becomes a
+    # condition-variable front that kicks the tick loop the moment
+    # rows arrive and lingers up to coalesce_wait_us for more client
+    # rows (stopping early at coalesce_rows) so concurrent sessions
+    # share one dispatch. Admission control rides it: under exec-
+    # backlog or burn-rate overload (see _ingress_overloaded) client
+    # PROPOSE frames beyond the pending bound are dropped at ingress
+    # (clients retry) — bounded queueing instead of tail blowup. The
+    # work_pending idle fast path is untouched (an idle replica still
+    # parks on idle_s). -nocoalesce restores the plain queue.Queue;
+    # coalesce_wait_us=0 keeps the kick but never lingers.
+    coalesce: bool = True
+    coalesce_wait_us: int = 200
+    coalesce_rows: int = 0  # 0 = half the device inbox (sized at boot)
+    # overlapped commit->exec->reply: when a dispatch's readback still
+    # shows committed-but-unexecuted slots and no follow-up traffic is
+    # queued, immediately run the follow-up dispatch in the SAME
+    # wakeup instead of letting execution wait out the next poll
+    # interval (the entire <exec_wait> paxtrace stage). The chased
+    # dispatch is the identical deterministic step the next wakeup
+    # would have run — byte-exact vs the strict-order path (pinned by
+    # tests/test_coalescer.py) and no new compiled variant.
+    # -nooverlapexec restores the one-dispatch-per-wakeup cadence.
+    overlap_exec: bool = True
     # paxmon flight recorder (obs/recorder.py): per-tick ring logging
     # dispatch regime + per-phase wall, served over the control
     # socket's TRACE verb. Default ON — the recorder's hot-path cost
@@ -393,7 +425,29 @@ class ReplicaServer:
         self._drain_wait_s = 0.0  # blocking queue wait (idle pacing)
         self._drain_work_s = 0.0  # frame-decode/dedup work in _drain
         self._last_scals = None  # newest published scalar vector
-        self.transport = Transport(me, addrs, metrics=self.metrics)
+        # ingress admission state — written by the protocol thread
+        # (_update_burn), read lock-free by the coalescer's gate on
+        # the transport reader threads (a plain bool + the published
+        # snapshot; never self.state). Backlog bound: a few exec
+        # batches of committed-but-unexecuted slots is normal pipeline
+        # depth; an order of magnitude past it means execution lost
+        # the race and new load must queue at the clients.
+        self._admit_backlog_limit = max(8 * self.cfg.exec_batch, 256)
+        self._burn_hot = False
+        self._burn_samples: deque[dict] = deque(maxlen=32)
+        self._burn_last_s = 0.0
+        # event-driven ingress front (tentpole of the p99-tail PR):
+        # injected as the transport's inbox queue, so reader threads,
+        # control verbs and beacons all feed the same cv-kicked,
+        # batch-forming, admission-gated path. -nocoalesce falls back
+        # to the transport's own queue.Queue.
+        self.coalescer = (batches.IngressCoalescer(
+            max_wait_us=self.flags.coalesce_wait_us,
+            max_rows=self.flags.coalesce_rows or max(self.cfg.inbox // 2, 1),
+            admit_gate=self._ingress_overloaded,
+            metrics=self.metrics) if self.flags.coalesce else None)
+        self.transport = Transport(me, addrs, inbox_queue=self.coalescer,
+                                   metrics=self.metrics)
         self.transport.trace = self.trace_sink
         self.transport.journal = self.journal
         self.queue = self.transport.queue
@@ -851,6 +905,58 @@ class ReplicaServer:
                     self.transport.dial_peer(q)
             time.sleep(0.05)
 
+    # SLO the replica-local burn evaluation runs against (the paxwatch
+    # SLO dataclass defaults, on a window short enough for admission
+    # to react within a couple of seconds)
+    _BURN_SLO_MS = 50.0
+    _BURN_WINDOW_S = 2.0
+
+    def _ingress_overloaded(self) -> bool:
+        """Admission signal for the ingress coalescer — called by the
+        transport's READER threads, so it reads only the published
+        snapshot and a plain bool (never ``self.state``). Overload =
+        the paxmon exec backlog (committed-but-unexecuted) beyond the
+        boot-sized bound, or the replica-local paxwatch burn-rate
+        alarm (_update_burn). The coalescer turns a True verdict into
+        counted ingress drops once its own pending bound is exceeded —
+        bounded queueing at the clients instead of tail blowup."""
+        snap = self.snapshot
+        fr = int(snap.get("frontier", -1))
+        ex = int(snap.get("executed", fr))
+        return fr - ex > self._admit_backlog_limit or self._burn_hot
+
+    def _update_burn(self, now: float) -> None:
+        """Feed the tick-wall histogram's cumulative bad/total pair
+        through the SAME ``burn_alarm`` detector the cluster watcher
+        runs (obs/watch.py), replica-locally at ~4 Hz, and edge-journal
+        the verdict — the admission gate's second input. The bad-bucket
+        derivation mirrors ``flatten_cluster_stats``: a bucket is bad
+        when its LOWER edge clears the SLO; the overflow bin is always
+        bad."""
+        if now - self._burn_last_s < 0.25:
+            return
+        self._burn_last_s = now
+        h = self._h_tick
+        bad = sum(c for i, c in enumerate(h.counts)
+                  if i == len(h.counts) - 1
+                  or (0 < i <= len(h.bounds)
+                      and h.bounds[i - 1] >= self._BURN_SLO_MS))
+        self._burn_samples.append({"t": now, "hist_total": h.total,
+                                   "hist_bad": bad, "replicas": {}})
+        alarm = burn_alarm(list(self._burn_samples),
+                           window_s=self._BURN_WINDOW_S,
+                           slo_ms=self._BURN_SLO_MS)
+        hot = alarm is not None
+        if hot and not self._burn_hot:
+            self.journal.record(
+                EV_ALARM, subject=self.me,
+                value=int(alarm["evidence"].get("window_s", 0) * 1e3),
+                aux=DET_BURN)
+        elif self._burn_hot and not hot:
+            self.journal.record(EV_ALARM_CLEAR, subject=self.me,
+                                aux=DET_BURN)
+        self._burn_hot = hot
+
     def _tick(self) -> None:
         # idle throttle: a quiet replica (empty inbox, no output, no
         # pending execution last step) steps at ~20Hz instead of every
@@ -869,6 +975,7 @@ class ReplicaServer:
         # queue wait subtracted — idle pacing is not drain cost
         self._drain_work_s = (time.perf_counter() - t0
                               - self._drain_wait_s)
+        self._update_burn(time.monotonic())
         if (self._boot_pending is not None
                 and time.monotonic() >= self._boot_pending):
             self._boot_pending = None
@@ -907,7 +1014,9 @@ class ReplicaServer:
                     monotonic_ns(), KIND_IDLE_SKIP, 0, 0, 0,
                     self.snapshot["frontier"], 0,
                     int(self._drain_work_s * 1e6), 0, 0, 0, 0, 0, 0,
-                    chaos_faults=self.transport.chaos_faults_total())
+                    chaos_faults=self.transport.chaos_faults_total(),
+                    coal_wake=(self.coalescer._c_wakeups.value
+                               if self.coalescer is not None else 0))
             # skipping IS being idle: without this the next poll waits
             # only tick_s (2 ms) and a quiet replica spins the skip
             # check at 500 Hz instead of idle_s pacing
@@ -932,6 +1041,28 @@ class ReplicaServer:
             self._become_leader()
             self._last_elect = time.monotonic()
         self._device_tick(self.inbox)
+        # overlapped commit->exec->reply (the exec chase): a slot this
+        # dispatch committed executes in the NEXT dispatch — which,
+        # with an empty queue, used to fire only after the poll
+        # timeout: the entire <exec_wait> paxtrace stage. Run the
+        # follow-up dispatch(es) in THIS wakeup while backlog remains
+        # and no fresh traffic is queued. Each chased dispatch is the
+        # identical deterministic step the next wakeup would have run
+        # (same fuse/narrow decision inputs, no new compiled variant),
+        # so replies and state are byte-exact vs the strict cadence —
+        # merely earlier in wall time. Bounded, with a forward-
+        # progress check: a wedged backlog (execution blocked on a
+        # commit hole) must park on the poll loop, not spin here.
+        if self.flags.overlap_exec:
+            for _ in range(8):
+                snap = self.snapshot
+                prev_exec = int(snap.get("executed", -1))
+                if (snap["frontier"] <= prev_exec or self.inbox.fill
+                        or not self.queue.empty()):
+                    break
+                self._device_tick(self.inbox)
+                if int(self.snapshot.get("executed", -1)) <= prev_exec:
+                    break  # no forward progress: stop chasing
         self._last_step = time.monotonic()
         self._c_ticks.inc(tick_inc)
 
@@ -1401,6 +1532,15 @@ class ReplicaServer:
                                 value=dropped)
             raise FatalReplicaError(self.fatal)
         drain_s, self._drain_work_s = self._drain_work_s, 0.0
+        # coalescer telemetry for the recorder row (schema v7): the
+        # rows the ingress front batched into this tick's drain, and
+        # the cumulative wakeup kicks. A chased dispatch (overlap_exec)
+        # reads 0 — its inbox came from no drain.
+        coal = self.coalescer
+        coal_occ = coal_wake = 0
+        if coal is not None:
+            coal_occ, coal.last_occupancy = coal.last_occupancy, 0
+            coal_wake = coal._c_wakeups.value
         rec = _InflightTick(
             cols=cols, n_rows=n_rows, out_mats=out_mats,
             exec_mats=exec_mats, scals=scals, k=k,
@@ -1412,7 +1552,7 @@ class ReplicaServer:
             drain_us=int(drain_s * 1e6),
             enqueue_us=int((t_enq - t0) * 1e6),
             readback_us=int((t_rb - t_host) * 1e6),
-            t_rb_ns=t_rb_ns)
+            t_rb_ns=t_rb_ns, coal_occ=coal_occ, coal_wake=coal_wake)
         self._inflight = rec
         # defer only when the next dispatch is imminent (traffic
         # already queued): its enqueue is what the host phases hide
@@ -1509,7 +1649,8 @@ class ReplicaServer:
                 rec.readback_us, int(host_s * 1e6) if overlapped else 0,
                 int(persist_s * 1e6), int(dispatch_s * 1e6),
                 int(reply_s * 1e6), rec.t_rb_ns,
-                chaos_faults=self.transport.chaos_faults_total())
+                chaos_faults=self.transport.chaos_faults_total(),
+                coal_occ=rec.coal_occ, coal_wake=rec.coal_wake)
 
     # -- paxtrace: slot assignment + commit stamps (protocol thread) --
 

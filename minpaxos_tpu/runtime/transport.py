@@ -106,6 +106,9 @@ class Transport:
             # hot path stays a plain attribute add on the _Conn
             metrics.fn_gauge("peer_conns_alive", self._peers_alive)
             metrics.fn_gauge("client_conns", lambda: len(self.clients))
+            # ingress depth: works for a plain Queue and for the
+            # IngressCoalescer (both expose qsize); sampled at snapshot
+            metrics.fn_gauge("ingress_queue_depth", self.queue.qsize)
             for attr in ("frames_in", "rows_in", "bytes_in", "frames_out"):
                 metrics.fn_gauge(f"net_{attr}",
                                  lambda a=attr: self._net_total(a))

@@ -321,6 +321,71 @@ def test_concurrency_out_of_scope_file_ignored():
                     "concurrency") == []
 
 
+# coalescer cv discipline (ISSUE 15): the ingress coalescer's wakeup
+# condition variable counts as a lock for the blocking-under-lock rule
+# — a socket read while holding self._cv would stall every client
+# reader's enqueue behind one peer's TCP timeout. cv.wait itself is
+# exempt (it releases the lock while parked).
+
+CV_BAD = '''
+import threading, socket
+
+class IngressCoalescer:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def get(self, sock):
+        with self._cv:
+            data = sock.recv(4096)     # blocking read under the cv
+            self._items.append(data)
+            return self._items.pop(0)
+'''
+
+CV_CLEAN = '''
+import threading, socket
+
+class IngressCoalescer:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()          # kick: O(1) under the cv
+
+    def get(self, sock):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(0.05)    # releases the cv while parked
+            item = self._items.pop(0)
+        data = sock.recv(4096)         # blocking work outside the cv
+        return item, data
+'''
+
+
+def test_concurrency_cv_blocking_read_fires():
+    vs = lint_src("minpaxos_tpu/runtime/batches.py", CV_BAD,
+                  "concurrency")
+    msgs = "\n".join(v.msg for v in vs)
+    assert "blocking call `recv` while holding a lock" in msgs, vs
+
+
+def test_concurrency_cv_clean_coalescer_quiet():
+    assert lint_src("minpaxos_tpu/runtime/batches.py", CV_CLEAN,
+                    "concurrency") == []
+
+
+def test_concurrency_real_coalescer_clean():
+    # the shipped coalescer must satisfy its own lint: nothing
+    # blocking under self._cv in runtime/batches.py
+    src = (Path(__file__).resolve().parents[1]
+           / "minpaxos_tpu/runtime/batches.py").read_text()
+    vs = lint_src("minpaxos_tpu/runtime/batches.py", src, "concurrency")
+    assert vs == [], vs
+
+
 # donated-state: self.state's buffers are donated into the jitted step;
 # only the protocol thread (_run and what it calls) may touch them —
 # the pipelined tick loop doubles the in-flight references, so the

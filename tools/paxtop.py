@@ -78,7 +78,8 @@ DERIVED_ROW_KEYS = (
     "id", "ok", "role", "protocol", "frontier", "lag", "fatal", "error",
     "dispatches", "ticks", "idle_skips", "committed", "chaos_injected",
     "narrow_fallbacks", "trace_spans", "trace_dropped", "exec_backlog",
-    "mix_pct", "tick_p50_ms", "tick_p99_ms", "commits_per_s", "health")
+    "mix_pct", "tick_p50_ms", "tick_p99_ms", "commits_per_s",
+    "coalesce", "health")
 EVENT_ROW_KEYS = ("rid", "t_wall_s", "age_s", "kind", "severity",
                   "subject", "value", "aux", "trace_id")
 
@@ -197,6 +198,18 @@ def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
         hist = (mx.get("histograms") or {}).get("tick_wall_ms") or {}
         row["tick_p50_ms"] = hist.get("p50", 0.0)
         row["tick_p99_ms"] = hist.get("p99", 0.0)
+        # ingress-coalescer health (ISSUE 15): cv wakeups delivered to
+        # a parked tick loop, max-wait deadline expiries, admission
+        # rejects, and the median coalesced batch size — all zero on a
+        # -nocoalesce server (the keys stay present: stable schema)
+        chist = (mx.get("histograms") or {}).get("coalesce_batch_rows") or {}
+        row["coalesce"] = {
+            "wakeups": counters.get("coalesce_wakeups", 0),
+            "deadline_hits": counters.get("coalesce_deadline_hits", 0),
+            "rejects": counters.get("coalesce_admission_rejects", 0),
+            "occ_p50": chist.get("p50", 0.0),
+            "queue_depth": counters.get("ingress_queue_depth", 0),
+        }
         ops = None
         if prev is not None and dt > 0:
             for p in prev.get("replicas", []):
@@ -221,6 +234,16 @@ def _abbrev(n: int) -> str:
     return str(n)
 
 
+def _fmt_coalesce(c: dict | None) -> str:
+    """COALESCE column: wakeups/deadline-hits/rejects (abbreviated) —
+    a live coalescer shows wakeups climbing with traffic; rejects > 0
+    means the admission gate is actively shedding."""
+    if not c:
+        return "-"
+    return (f"{_abbrev(c['wakeups'])}/{_abbrev(c['deadline_hits'])}"
+            f"/{_abbrev(c['rejects'])}")
+
+
 def _fmt_health(h: dict | None) -> str:
     if not h:
         return "-"
@@ -243,7 +266,7 @@ def _render(resp: dict, rows: list[dict], clear: bool,
            f"{'COMMIT/S':>9} {'BACKLOG':>8} {'DISP':>8} {'FULL%':>6} "
            f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'CHAOS':>7} "
            f"{'NARRFB':>6} {'TRACE':>11} {'p50ms':>7} {'p99ms':>8} "
-           f"{'HEALTH':<18}")
+           f"{'COALESCE':>13} {'HEALTH':<18}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for r in rows:
@@ -264,6 +287,7 @@ def _render(resp: dict, rows: list[dict], clear: bool,
             f"{_abbrev(r['trace_spans']) + '/' + _abbrev(r['trace_dropped']):>11} "
             f"{r['tick_p50_ms']:>7.2f} "
             f"{r['tick_p99_ms']:>8.2f} "
+            f"{_fmt_coalesce(r.get('coalesce')):>13} "
             f"{_fmt_health(r.get('health')):<18}")
     if events:
         # paxwatch EVENTS tail pane: the newest journal events across

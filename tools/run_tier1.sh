@@ -70,6 +70,12 @@ env JAX_PLATFORMS=cpu python tools/obs_smoke.py --resident || exit 1
 echo "== paxchaos smoke (2 seeded fault schedules + invariant checker) =="
 env JAX_PLATFORMS=cpu python tools/chaos.py --smoke || exit 1
 
+# The concurrent-client swarm leg (ISSUE 15) rides the pytest suite
+# below: tests/test_swarm.py drives 64 real closed-loop TCP sessions
+# through the ingress coalescer against an in-process cluster (~18 s,
+# no new compiled variants); the 1024-session overload leg is marked
+# `slow` and runs only in the full suite (pytest tests/ -m slow).
+
 if [ "${1:-}" = "smoke" ]; then
     exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         -k "runtime_units or wire or fused" \

@@ -164,8 +164,45 @@ def collect_tcp_row(repo: Path = REPO) -> dict | None:
         "ops_per_sec": rec.get("ops_per_sec"),
         "serial_p50_ms": rec.get("serial_p50_ms"),
         "serial_p99_ms": rec.get("serial_p99_ms"),
+        "stage_tail": _stage_tail(rec.get("serial_traced")),
+        "stage_tail_baseline": _stage_tail(
+            (rec.get("serial_cadence_baseline") or {}).get("serial_traced")),
         "mtime_utc": time.strftime(
             "%Y-%m-%d", time.gmtime(os.path.getmtime(path))),
+    }
+
+
+def _stage_tail(traced: dict | None) -> dict | None:
+    """The tail-trajectory row (ISSUE 15): commit / exec_wait p99 and
+    their share of the traced end-to-end p99, from a serial leg's
+    embedded paxtrace stage table — so the tail's WHERE is tracked
+    across PRs like throughput, not just its size."""
+    if not isinstance(traced, dict):
+        return None
+    stages = traced.get("stages") or {}
+    total = (traced.get("total_ms") or {}).get("p99")
+    commit = (stages.get("commit") or {}).get("p99")
+    exec_wait = (stages.get("exec_wait") or {}).get("p99")
+    if total is None or commit is None or exec_wait is None:
+        return None
+    # share of the tail owned by commit+exec_wait, from the
+    # tail-command stage MEANS (per-stage p99s are order statistics
+    # of different commands — their sum can exceed the total p99);
+    # fall back to the p99 ratio for pre-PR-12 artifacts without the
+    # tail stanza
+    means = (traced.get("tail") or {}).get("stage_means_ms") or {}
+    mean_total = sum(means.values())
+    if mean_total:
+        share = (means.get("commit", 0.0)
+                 + means.get("exec_wait", 0.0)) / mean_total
+    else:
+        share = (commit + exec_wait) / total if total else None
+    return {
+        "commit_p99_ms": round(commit, 3),
+        "exec_wait_p99_ms": round(exec_wait, 3),
+        "total_p99_ms": round(total, 3),
+        "commit_exec_share": round(share, 3) if share is not None else None,
+        "worst_stage": (traced.get("tail") or {}).get("worst_stage"),
     }
 
 
@@ -273,6 +310,23 @@ def render_markdown(bench, tcp, progress, health=None) -> str:
                 f"| {_fmt(tcp['ops_per_sec'])} "
                 f"| {_fmt(tcp['serial_p50_ms'], 2)} "
                 f"| {_fmt(tcp['serial_p99_ms'], 2)} |"]
+        rows = [("event-driven", tcp.get("stage_tail")),
+                ("cadence baseline", tcp.get("stage_tail_baseline"))]
+        if any(st for _, st in rows):
+            out += ["", "### Serial tail attribution (paxtrace stage "
+                    "table, p99 ms)", "",
+                    "| leg | commit | exec_wait | total | commit+exec "
+                    "share | worst stage |", "|" + "---|" * 6]
+            for label, st in rows:
+                if not st:
+                    continue
+                share = st.get("commit_exec_share")
+                out.append(
+                    f"| {label} | {_fmt(st['commit_p99_ms'], 2)} "
+                    f"| {_fmt(st['exec_wait_p99_ms'], 2)} "
+                    f"| {_fmt(st['total_p99_ms'], 2)} "
+                    f"| {f'{share:.0%}' if share is not None else '-'} "
+                    f"| {st.get('worst_stage') or '-'} |")
     if health:
         out += ["", "## Cluster health (paxwatch artifacts)", "",
                 "| artifact | run | ok | alarms | stall live | faults "
