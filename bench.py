@@ -458,11 +458,17 @@ def measure(shape: tuple[int, int, int, int] | None = None,
     inbox_rows = int(os.environ.get("MP_BENCH_INBOX", "0") or 0) \
         or (p + 2 * cu_rows + 64 + 64)
     compact_rows = int(os.environ.get("MP_BENCH_COMPACT", "0") or 0)
+    # flexible quorums (PR 16): a --ladder winner may carry a
+    # non-default (q1, q2) pair from the quorum sweep — threaded to
+    # this child via env exactly like the shape/capacity knobs (0 =
+    # majority sentinel, the byte-identical default)
+    q1_cfg = int(os.environ.get("MP_BENCH_Q1", "0") or 0)
+    q2_cfg = int(os.environ.get("MP_BENCH_Q2", "0") or 0)
     cfg = MinPaxosConfig(
         n_replicas=5, window=w, inbox=inbox_rows,
         exec_batch=p, kv_pow2=15 if on_tpu else cpu_kv_pow2(p),
         catchup_rows=cu_rows, recovery_rows=64,
-        compact_inbox=compact_rows)
+        compact_inbox=compact_rows, q1=q1_cfg, q2=q2_cfg)
     t_boot = time.perf_counter()
     try:
         # key_space < KV capacity: the run inserts ~dispatches*k*p
@@ -648,6 +654,8 @@ def measure(shape: tuple[int, int, int, int] | None = None,
                 "resident": RESIDENT,
                 "proposals_per_round": g * p,
                 "n_replicas": cfg.n_replicas,
+                "q1": cfg.quorum1,
+                "q2": cfg.quorum2,
                 "n_shards": g,
                 "platform": platform,
                 "partial": "healthy_phase_only; fault leg/side configs "
@@ -842,6 +850,9 @@ def measure(shape: tuple[int, int, int, int] | None = None,
             "watch_events": watch_journal.counts_by_kind(),
             "kill_recover": kill_recover,
             "n_replicas": cfg.n_replicas,
+            # resolved quorum sizes (PR 16): default = majority
+            "q1": cfg.quorum1,
+            "q2": cfg.quorum2,
             "n_shards": g,
             "platform": platform,
             "baseline": ("north-star 12.5e6 inst/s/chip (1M concurrent, "
@@ -1018,6 +1029,11 @@ def _run_ladder_mode() -> None:
                     # compaction, not re-derive the default sizing
                     MP_BENCH_INBOX=str(win.get("inbox") or 0),
                     MP_BENCH_COMPACT=str(win.get("compact_inbox") or 0),
+                    # flexible quorums: a quorum-sweep winner carries
+                    # its (q1, q2); the record re-runs the pair that
+                    # won (resolved majority == explicit majority)
+                    MP_BENCH_Q1=str(win.get("q1") or 0),
+                    MP_BENCH_Q2=str(win.get("q2") or 0),
                     # throughput shapes use economy catch-up sizing;
                     # kill/recover stays with the default-shape run
                     # (same policy as the TPU ladder's bigger rungs)

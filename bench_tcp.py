@@ -103,6 +103,13 @@ RUNTIME_KNOBS = {
     "coalesce_wait_us": os.environ.get("BENCH_TCP_COALESCE_WAIT_US",
                                        "200"),
     "overlap_exec": os.environ.get("BENCH_TCP_OVERLAP", "1") != "0",
+    # ISSUE-16 flexible quorums: replica count and the (q1, q2) pair
+    # compiled into every server ("0" = simple majority — the
+    # byte-identical default). The flex A/B legs flip these via
+    # _knobs; the server refuses a non-intersecting pair at boot.
+    "n_replicas": os.environ.get("BENCH_TCP_N", "3"),
+    "q1": os.environ.get("BENCH_TCP_Q1", "0"),
+    "q2": os.environ.get("BENCH_TCP_Q2", "0"),
 }
 
 
@@ -124,6 +131,7 @@ def _knob_args(keyhint: int, trace_pow2: str | None = None) -> list:
         args.append("-nocoalesce")
     if not RUNTIME_KNOBS["overlap_exec"]:
         args.append("-nooverlapexec")
+    args += ["-q1", RUNTIME_KNOBS["q1"], "-q2", RUNTIME_KNOBS["q2"]]
     return args
 
 
@@ -187,11 +195,12 @@ def _metrics_snapshot(maddr) -> dict:
 
 
 def _boot(proto_flag: str, env, tmp, shape) -> tuple[list, int]:
+    n = int(RUNTIME_KNOBS["n_replicas"])
     mport = free_ports(1)[0]
-    dports = free_ports(3, sibling_offset=CONTROL_OFFSET)
+    dports = free_ports(n, sibling_offset=CONTROL_OFFSET)
     procs = [subprocess.Popen(
         [sys.executable, "-m", "minpaxos_tpu.cli.master",
-         "-port", str(mport), "-N", "3"],
+         "-port", str(mport), "-N", str(n)],
         env=env, cwd=tmp, stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL)]
     time.sleep(1.5)
@@ -511,6 +520,28 @@ def main() -> None:
                     "-min", "bareminpaxos serial (coalesce+overlap OFF)")
         except Exception as e:  # noqa: BLE001
             rec["serial_cadence_baseline"] = {"error": repr(e)[:200]}
+        out_path.write_text(json.dumps(rec) + "\n")
+    # flexible-quorum paired A/B (ISSUE 16): two serial legs at N=5,
+    # same shape, same host, interleaved in one run — simple majority
+    # (q1=q2=3) vs the certified (q1=4, q2=2) ledger point. A commit
+    # barrier at q2=2 waits for ONE follower ack instead of two, so
+    # the traced <commit> stage p99 is the claim (tools/tail.py
+    # renders the stage tables). Skip with BENCH_TCP_FLEX=0.
+    if os.environ.get("BENCH_TCP_FLEX", "1") != "0":
+        ab = {}
+        for leg, kn in (("majority_q2_3", {"n_replicas": "5"}),
+                        ("flex_q1_4_q2_2", {"n_replicas": "5",
+                                            "q1": "4", "q2": "2"})):
+            try:
+                with _knobs(**kn):
+                    ab[leg] = run_serial("-min", f"serial N=5 {leg}")
+            except Exception as e:  # noqa: BLE001
+                ab[leg] = {"error": repr(e)[:200]}
+        ab["commit_p99_ms"] = {
+            leg: (ab[leg].get("serial_traced") or {})
+            .get("stages", {}).get("commit", {}).get("p99")
+            for leg in ("majority_q2_3", "flex_q1_4_q2_2")}
+        rec["flex_quorum_ab"] = ab
         out_path.write_text(json.dumps(rec) + "\n")
     # concurrent-client leg through the coalescer (BENCH_TCP_SWARM
     # sessions; 0 skips — CI runs 64, the full bench 256, the slow

@@ -55,7 +55,7 @@ SCOPE_PREFIXES = ("minpaxos_tpu/ops/", "minpaxos_tpu/models/")
 LEDGER_PATH = "minpaxos_tpu/analysis/quorum_golden.py"
 
 #: names that denote a quorum threshold; q1/q2 pin the phase
-_QUORUM_RE = re.compile(r"(^|_)(majority|quorum\d*|q1|q2)($|_)",
+_QUORUM_RE = re.compile(r"(^|_)(majority|quorum\d*|q1|q2|q_fast)($|_)",
                         re.IGNORECASE)
 _PHASE1_RE = re.compile(r"(^|_)(q1|quorum1|prepare_quorum)($|_)",
                         re.IGNORECASE)
@@ -92,6 +92,32 @@ def _formula(node: ast.expr):
         return "delegated"
     if isinstance(node, ast.Name) and _is_quorum_name(node.id):
         return "delegated"
+    # the 0-sentinel field convention (MinPaxosConfig.q1/q2/q_fast):
+    # a literal 0 means "use the default formula" — the resolving
+    # property (quorum1/quorum2/quorum_fast) carries the certified
+    # fallback, and runtime overrides are certified by
+    # verify.quorum.validate_config_quorums at cluster construction
+    if isinstance(node, ast.Constant) and node.value == 0 \
+            and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return "delegated"
+    # `self.qX or <formula>`: the sentinel-resolving property — certify
+    # the static fallback formula (the override path is host-validated)
+    if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or) \
+            and len(node.values) == 2:
+        first, fallback = node.values
+        first_name = (first.attr if isinstance(first, ast.Attribute)
+                      else first.id if isinstance(first, ast.Name)
+                      else None)
+        if first_name is not None and _is_quorum_name(first_name):
+            return _formula(fallback)
+    # a trace-time config branch between two certified thresholds
+    # (`cfg.quorum_fast if cfg.fast_path else cfg.quorum2`) delegates
+    # iff both arms delegate
+    if isinstance(node, ast.IfExp):
+        if (_formula(node.body) == "delegated"
+                and _formula(node.orelse) == "delegated"):
+            return "delegated"
 
     def ev(e: ast.expr, n: int):
         if isinstance(e, ast.Constant) and isinstance(e.value, int) \

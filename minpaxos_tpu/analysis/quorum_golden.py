@@ -40,23 +40,26 @@ GOLDEN_MAX_N = 16
 #: pairs at n = 3, 5, 7 pre-certify the flexible-quorum sweeps ROADMAP
 #: item 2 plans (small q2 for steady-state speed, large q1 for
 #: recovery: |Q1| + |Q2| > N).
+#: The unanimous pair (n, n) certifies MinPaxosConfig.quorum_fast
+#: (the fast-path fast quorum, which the kernel pins at n; trivially
+#: intersecting since n + n > n for every n >= 1).
 GOLDEN_THRESHOLDS: dict[int, tuple[tuple[int, int], ...]] = {
     1: ((1, 1),),
     2: ((2, 2), (1, 2), (2, 1)),
-    3: ((2, 2), (3, 1), (1, 3)),
-    4: ((3, 3), (3, 2), (2, 3), (4, 1), (1, 4)),
-    5: ((3, 3), (4, 2), (2, 4), (5, 1), (1, 5)),
-    6: ((4, 4), (4, 3), (3, 4), (5, 2), (2, 5)),
-    7: ((4, 4), (5, 3), (3, 5), (6, 2), (2, 6)),
-    8: ((5, 5), (5, 4), (4, 5), (6, 3), (3, 6)),
-    9: ((5, 5), (6, 4), (4, 6), (7, 3), (3, 7)),
-    10: ((6, 6), (6, 5), (5, 6)),
-    11: ((6, 6), (7, 5), (5, 7)),
-    12: ((7, 7), (7, 6), (6, 7)),
-    13: ((7, 7), (8, 6), (6, 8)),
-    14: ((8, 8), (8, 7), (7, 8)),
-    15: ((8, 8), (9, 7), (7, 9)),
-    16: ((9, 9), (9, 8), (8, 9)),
+    3: ((2, 2), (3, 1), (1, 3), (3, 3)),
+    4: ((3, 3), (3, 2), (2, 3), (4, 1), (1, 4), (4, 4)),
+    5: ((3, 3), (4, 2), (2, 4), (5, 1), (1, 5), (5, 5)),
+    6: ((4, 4), (4, 3), (3, 4), (5, 2), (2, 5), (6, 6)),
+    7: ((4, 4), (5, 3), (3, 5), (6, 2), (2, 6), (7, 7)),
+    8: ((5, 5), (5, 4), (4, 5), (6, 3), (3, 6), (8, 8)),
+    9: ((5, 5), (6, 4), (4, 6), (7, 3), (3, 7), (9, 9)),
+    10: ((6, 6), (6, 5), (5, 6), (10, 10)),
+    11: ((6, 6), (7, 5), (5, 7), (11, 11)),
+    12: ((7, 7), (7, 6), (6, 7), (12, 12)),
+    13: ((7, 7), (8, 6), (6, 8), (13, 13)),
+    14: ((8, 8), (8, 7), (7, 8), (14, 14)),
+    15: ((8, 8), (9, 7), (7, 9), (15, 15)),
+    16: ((9, 9), (9, 8), (8, 9), (16, 16)),
 }
 
 #: certified-intersecting grid systems (Fast Flexible Paxos 2008.02671):
@@ -91,4 +94,5 @@ GOLDEN_GRIDS: tuple[tuple[int, int, str, str], ...] = (
 #: the pass evaluates candidate source expressions against these.
 THRESHOLD_FORMULAS: dict[str, object] = {
     "n // 2 + 1": lambda n: n // 2 + 1,  # MinPaxosConfig.majority
+    "n": lambda n: n,  # MinPaxosConfig.quorum_fast (unanimous fast path)
 }

@@ -116,18 +116,49 @@ def build_schedule(name: str, seed: int, n: int) -> list[tuple]:
                                     jitter_s=0.008,
                                     reorder=3).to_dict()),
                   (dur, "clear", None)]
+    elif name == "flex_partition":
+        # the flexible-quorum non-intersection probe (ISSUE 16): cut
+        # off EXACTLY the q2-sized minority {n-2, n-1} under load. The
+        # quorum certificate (q1 + q2 > n) says the majority side keeps
+        # committing (it still holds a phase-2 quorum) while the island
+        # can neither commit (no leader inside) nor elect one (q1
+        # requires replicas it cannot reach) — no split-brain, just a
+        # starved minority the paxwatch stall detector must name.
+        t0 = 0.25 + float(rng.random()) * 0.15
+        dur = 1.2 + float(rng.random()) * 0.5
+        island = [n - 2, n - 1]
+        rest = list(range(n - 2))
+        events = [(t0, "install",
+                   plan().partition(rest, island).to_dict()),
+                  (t0 + dur, "clear", None)]
     else:
         raise ValueError(f"unknown schedule {name!r}")
     return events
 
 
 SCHEDULES = ("partition_heal", "isolated_leader", "flap", "loss_reorder",
-             "one_way", "delay_jitter", "dup_storm", "mixed")
+             "one_way", "delay_jitter", "dup_storm", "mixed",
+             "flex_partition")
 
 #: schedules whose fault makes commit progress IMPOSSIBLE while
 #: installed (leader cut off from every quorum): the runner verifies
 #: the stall instead of expecting mid-fault progress
 STALL_SCHEDULES = frozenset({"isolated_leader"})
+
+#: schedules where the fault starves a strict MINORITY while the
+#: cluster keeps committing: the runner asserts the paxwatch
+#: frontier-stall alarm fired LIVE naming a starved replica (and
+#: cleared after heal) instead of a global stall
+STARVED_SCHEDULES = frozenset({"flex_partition"})
+
+#: schedules that require a specific cluster shape — run_campaign
+#: applies these per-run overrides (n and the flexible quorum pair)
+#: regardless of the campaign-wide defaults. flex_partition probes the
+#: certified N=5 (q1=4, q2=2) point: the smallest shipped config where
+#: the phase-2 quorum is a strict minority (quorum_golden.py)
+SCHEDULE_SHAPES: dict[str, dict] = {
+    "flex_partition": {"n": 5, "q1": 4, "q2": 2},
+}
 
 
 # ---------------------------------------------------------- cluster
@@ -137,12 +168,14 @@ class ChaosCluster:
     tests/test_distributed.py harness shape, importable by tools)."""
 
     def __init__(self, n: int = 3, store_dir: str | None = None,
-                 durable: bool = False, tick_s: float = 0.001):
+                 durable: bool = False, tick_s: float = 0.001,
+                 q1: int = 0, q2: int = 0):
         # late imports: chaos/__init__ must stay importable without JAX
         from minpaxos_tpu.models.minpaxos import MinPaxosConfig
         from minpaxos_tpu.runtime.master import Master, register_with_master
         from minpaxos_tpu.runtime.replica import ReplicaServer, RuntimeFlags
         from minpaxos_tpu.utils.netutil import CONTROL_OFFSET, free_ports
+        from minpaxos_tpu.verify.quorum import validate_config_quorums
 
         self.n = n
         self._tmp = None
@@ -167,7 +200,11 @@ class ChaosCluster:
                                      timeout_s=10.0)
             self.cfg = MinPaxosConfig(
                 n_replicas=n, window=1 << 10, inbox=1024, exec_batch=512,
-                kv_pow2=12, catchup_rows=64, recovery_rows=64)
+                kv_pow2=12, catchup_rows=64, recovery_rows=64,
+                q1=q1, q2=q2)
+            # certify intersection BEFORE the replicas boot: a chaos
+            # harness must never drive a split-brain-capable cluster
+            validate_config_quorums(self.cfg)
             self._mk_flags = lambda: RuntimeFlags(
                 durable=durable, store_dir=store_dir, tick_s=tick_s)
             for i in range(n):
@@ -215,7 +252,8 @@ class ChaosCluster:
 
 def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
                  timeout_s: float = 60.0, log=print,
-                 events: list[tuple] | None = None) -> dict:
+                 events: list[tuple] | None = None,
+                 q1: int = 0, q2: int = 0) -> dict:
     """One schedule end-to-end; returns a JSON-able result dict whose
     ``ok`` is the conjunction of load completion, exactly-once replies,
     real fault injection (> 0), post-heal commit resumption,
@@ -237,6 +275,8 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
     t_wall = time.monotonic()
     result = {"schedule": name, "seed": seed, "ok": False, "events":
               [(round(t, 3), op) for t, op, _ in events]}
+    if q1 or q2:
+        result["q1"], result["q2"] = q1, q2
     watcher: HealthWatcher | None = None
     samples: dict[int, list[int]] = {i: [] for i in range(n)}
     sample_t: list[float] = []
@@ -245,7 +285,7 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
     # after it (client construction can time out on a busy host) runs
     # under the finally that stops it — a leaked master + N replica
     # threads would degrade every later run of the campaign
-    cluster = ChaosCluster(n=n)
+    cluster = ChaosCluster(n=n, q1=q1, q2=q2)
     cli = None
 
     def sampler():
@@ -355,6 +395,12 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
         if name in STALL_SCHEDULES:
             result["watch"]["stall"] = _stall_verdict(
                 watcher, fault_marks, expected_subject=0)
+        elif name in STARVED_SCHEDULES:
+            # the partitioned island {n-2, n-1} is the starved side:
+            # the alarm must name one of ITS replicas, live
+            result["watch"]["stall"] = _stall_verdict(
+                watcher, fault_marks,
+                expected_subject=frozenset({n - 2, n - 1}))
         result["client_events"] = cli.journal.counts_by_kind()
         # cluster-wide EVENTS fan-out: the journals must show the
         # fault-plan installs/clears this schedule just drove
@@ -385,7 +431,7 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
             result["stall_observed"] = _stalled_during_fault(
                 sample_t, samples, fault_marks)
         stall_live = True
-        if name in STALL_SCHEDULES:
+        if name in STALL_SCHEDULES or name in STARVED_SCHEDULES:
             sv = result["watch"]["stall"]
             stall_live = (sv["fired_in_window"] and sv["attributed"]
                           and sv["cleared"])
@@ -425,14 +471,20 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
 
 def _stall_verdict(watcher: HealthWatcher,
                    fault_marks: list[tuple[float, float, str]],
-                   expected_subject: int) -> dict:
+                   expected_subject) -> dict:
     """The live-detection verdict for a stall schedule: did the
     frontier-stall alarm RAISE inside the installed-fault window
     (wall-clock ground truth from the fired chaos events), did it
     name the isolated replica, and did it CLEAR once the cluster
     healed and resumed committing. This is the closed loop the paxwatch
     layer exists for — the same stall the offline checker proves from
-    frontier samples, detected and attributed while it was happening."""
+    frontier samples, detected and attributed while it was happening.
+
+    ``expected_subject`` is a replica id, or a set of ids when any
+    member of a partitioned group is a correct attribution (the
+    flex_partition island)."""
+    if not isinstance(expected_subject, (set, frozenset)):
+        expected_subject = frozenset({expected_subject})
     installs = [tw for _, tw, op in fault_marks if op == "install"]
     clears = [tw for _, tw, op in fault_marks if op == "clear"]
     stall = [a for a in watcher.alarms
@@ -442,7 +494,7 @@ def _stall_verdict(watcher: HealthWatcher,
     in_win = [a for a in stall if lo <= a["t_raised"] <= hi]
     return {
         "fired_in_window": bool(in_win),
-        "attributed": any(a["subject"] == expected_subject
+        "attributed": any(a["subject"] in expected_subject
                           for a in in_win),
         "cleared": bool(stall) and all(a["t_cleared"] is not None
                                        for a in stall),
@@ -492,9 +544,14 @@ def run_campaign(schedules: list[str], seeds: list[int], n: int = 3,
     if pairs is None:
         pairs = [(seed, name) for seed in seeds for name in schedules]
     for i, (seed, name) in enumerate(pairs):
-        log(f"[paxchaos] schedule {name} seed {seed} ...")
+        shape = SCHEDULE_SHAPES.get(name, {})
+        log(f"[paxchaos] schedule {name} seed {seed}"
+            + (f" shape {shape}" if shape else "") + " ...")
         try:
-            r = run_schedule(name, seed, n=n, ops_n=ops_n, log=log)
+            r = run_schedule(name, seed, n=shape.get("n", n),
+                             ops_n=ops_n, log=log,
+                             q1=shape.get("q1", 0),
+                             q2=shape.get("q2", 0))
         except Exception as e:  # paxlint: disable=broad-except
             # a crashed run must become a seeded failure verdict, not
             # abort the remaining schedules of a CI campaign

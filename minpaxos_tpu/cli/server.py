@@ -154,6 +154,17 @@ def main(argv=None) -> None:
     p.add_argument("-watchring", type=int, default=1024,
                    help="paxwatch event-ring capacity per writer"
                         " thread (8 int64 fields per event)")
+    p.add_argument("-q1", type=int, default=0,
+                   help="flexible phase-1 (prepare/election) quorum"
+                        " size; 0 = simple majority. Safety needs"
+                        " q1 + q2 > N — the server refuses a"
+                        " non-intersecting pair at boot with the"
+                        " refutation witness (verify/quorum.py)")
+    p.add_argument("-q2", type=int, default=0,
+                   help="flexible phase-2 (accept/commit) quorum size;"
+                        " 0 = simple majority. Smaller q2 = fewer acks"
+                        " per commit (Flexible Paxos), paid for at"
+                        " leader change by a larger -q1")
     p.add_argument("-storedir", default=".",
                    help="stable store directory")
     p.add_argument("-platform", default="cpu",
@@ -209,7 +220,13 @@ def main(argv=None) -> None:
         exec_batch=args.execbatch or args.inbox, kv_pow2=args.kvpow2,
         catchup_rows=256, recovery_rows=256,
         gossip_ticks=args.gossipticks, noop_delay=args.noopdelay,
-        explicit_commit=args.classic and not args.mencius)
+        explicit_commit=args.classic and not args.mencius,
+        q1=args.q1, q2=args.q2)
+    # refuse a split-brain-capable (q1, q2) BEFORE serving traffic;
+    # the raised witness is the pair of disjoint quorums
+    from minpaxos_tpu.verify.quorum import validate_config_quorums
+
+    validate_config_quorums(cfg)
     prof = cProfile.Profile() if args.cpuprofile else None
     flags = RuntimeFlags(dreply=args.dreply,
                          durable=args.durable, thrifty=args.thrifty,

@@ -317,6 +317,12 @@ class Cluster:
     """
 
     def __init__(self, cfg: MinPaxosConfig, ext_rows: int = 1024):
+        # certify the (q1, q2[, qf]) thresholds this config compiles
+        # before any kernel runs them (verify/quorum.py; the model
+        # checker bypasses this wrapper to plant mutants on purpose)
+        from minpaxos_tpu.verify.quorum import validate_config_quorums
+
+        validate_config_quorums(cfg)
         self.cfg = cfg
         self.ext_rows = ext_rows
         states = _tree_stack([init_replica(cfg, i) for i in range(cfg.n_replicas)])
@@ -372,8 +378,15 @@ class Cluster:
     def propose(self, ops, keys, vals, cmd_ids, client_id: int, to: int | None = None):
         """Queue client PROPOSE rows for delivery to ``to`` (default:
         current leader) on the next step. Batches larger than
-        ``ext_rows`` are chunked across steps."""
-        to = self.leader if to is None else to
+        ``ext_rows`` are chunked across steps. ``to=-1`` broadcasts
+        the rows to EVERY replica — the Fast Flexible Paxos client
+        shape (cfg.fast_path: followers fast-accept them directly);
+        replies are still tracked at the leader, the only committer."""
+        broadcast = to == -1
+        if broadcast:
+            to = self.leader
+        else:
+            to = self.leader if to is None else to
         if to < 0:
             raise ValueError("no known leader; call elect() first or pass to=")
         ops = np.asarray(ops, dtype=np.int32)
@@ -399,9 +412,11 @@ class Cluster:
         self._prop_keys.setdefault(to, KeyBuf()).append(
             pack_reply_key(client_id, cmd_ids))
         batch = MsgBatch(**{f: row[f] for f in MsgBatch._fields})
-        for lo in range(0, n, self.ext_rows):
-            self._ext_queue.append((to, jax.tree_util.tree_map(
-                lambda x: x[lo : lo + self.ext_rows], batch)))
+        targets = (range(self.cfg.n_replicas) if broadcast else (to,))
+        for tgt in targets:
+            for lo in range(0, n, self.ext_rows):
+                self._ext_queue.append((tgt, jax.tree_util.tree_map(
+                    lambda x: x[lo : lo + self.ext_rows], batch)))
 
     def _drain_ext(self) -> MsgBatch:
         r, m = self.cfg.n_replicas, self.ext_rows

@@ -161,7 +161,11 @@ def mencius_step_impl(
     under the TCP runtime's multi-substep dispatches."""
     S, R = cfg.window, cfg.n_replicas
     M = inbox.kind.shape[0]
-    majority = cfg.majority
+    # flexible quorums (models/minpaxos.py config field note): the
+    # takeover phase-1 audits take q1, ACCEPT-vote commit scans q2 —
+    # both cfg.majority by default
+    quorum1 = cfg.quorum1
+    quorum2 = cfg.quorum2
     me = state.me
     k = inbox.kind
     idx = jnp.arange(S, dtype=jnp.int32)
@@ -518,7 +522,7 @@ def mencius_step_impl(
     driven_by_me = own_mask | (
         (state.ballot > 0) & (jnp.mod(state.ballot, 16) == me))
     my_commit = (driven_by_me & (state.status == ACCEPTED)
-                 & (n_votes >= majority))
+                 & (n_votes >= quorum2))
     state = state._replace(
         status=jnp.where(my_commit, COMMITTED, state.status))
     old_upto = state.committed_upto
@@ -627,7 +631,7 @@ def mencius_step_impl(
              & (rt_slots < state.crt_inst)
              & driven_by_me[rt_rel_safe]
              & (state.status[rt_rel_safe] == ACCEPTED)
-             & (n_votes[rt_rel_safe] < majority))
+             & (n_votes[rt_rel_safe] < quorum2))
     rt = MsgBatch(
         kind=jnp.where(rt_ok, int(MsgKind.ACCEPT), 0).astype(jnp.int32),
         src=jnp.full(K3, me, jnp.int32),
@@ -735,7 +739,7 @@ def mencius_step_impl(
     in_tk_span = (idx_abs >= blocking) & (
         idx_abs < blocking + K2) & (idx_abs < state.crt_inst)
     fill = (do_tk & in_tk_span & (state.status == NONE)
-            & (pv_cnt >= majority))
+            & (pv_cnt >= quorum1))
     state = state._replace(
         status=jnp.where(fill, ACCEPTED, state.status),
         ballot=jnp.where(fill, tb, state.ballot),
@@ -745,7 +749,7 @@ def mencius_step_impl(
         votes=jnp.where(fill, me_bit, state.votes),
     )
     redrive = (do_tk & in_tk_span & (state.status == ACCEPTED)
-               & ((state.ballot == tb) | (pv_cnt >= majority)))
+               & ((state.ballot == tb) | (pv_cnt >= quorum1)))
     bump = redrive & (state.ballot != tb)
     state = state._replace(
         ballot=jnp.where(bump, tb, state.ballot),
@@ -949,7 +953,9 @@ class MenciusCluster:
 
     def __init__(self, cfg: MinPaxosConfig, ext_rows: int = 1024):
         from minpaxos_tpu.models.cluster import ClusterState, cluster_step
+        from minpaxos_tpu.verify.quorum import validate_config_quorums
 
+        validate_config_quorums(cfg)
         self.cfg = cfg
         self.ext_rows = ext_rows
         self._cluster_step = cluster_step

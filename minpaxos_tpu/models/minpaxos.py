@@ -131,10 +131,55 @@ class MinPaxosConfig(NamedTuple):
     # bookkeeping, paxos.go:57-70). Static, so XLA specializes the
     # kernel per protocol.
     explicit_commit: bool = False
+    # Flexible quorums (Flexible Paxos, PAPERS.md 1608.06696): phase-1
+    # (prepare/leader-change + no-op-fill audits) and phase-2 (ACCEPT-
+    # vote commit scans) quorum sizes. 0 = the majority default, so a
+    # default-constructed config compiles the exact same thresholds as
+    # before (byte-identical kernels, tests/test_kernel_golden.py).
+    # Safety needs only q1 + q2 > n_replicas — certified at
+    # construction by verify/quorum.py via the Cluster/server hosts
+    # (the kernel itself never validates: verify/mc.py plants
+    # non-intersecting mutants through these very fields).
+    q1: int = 0
+    q2: int = 0
+    # Fast path (Fast Flexible Paxos, PAPERS.md 2008.02671): followers
+    # accept client PROPOSEs directly (1 delivery before the leader's
+    # ACCEPT broadcast) and fast-ack the leader, which counts a fast
+    # ack only when its own slot assignment carries the same command
+    # (value-fingerprint match) — mismatches fall back to the classic
+    # path for free because the leader still broadcasts ACCEPTs and
+    # same-ballot overwrite converges followers to the leader's value.
+    # While fast_path is on, EVERY commit takes quorum_fast votes: the
+    # leader-change sweep (7e) adopts same-ballot values by max-vballot
+    # with an index tiebreak, so divergent same-ballot rows must never
+    # coexist with a commit — unanimity (q_fast = n) guarantees the
+    # committed value is on every replica any phase-1 quorum can see.
+    # That trades liveness under failure (one dead replica stalls
+    # commits until healed) for the 1-RTT happy path; classic (q1, q2)
+    # configs remain the production shape.
+    fast_path: bool = False
+    q_fast: int = 0  # 0 = n_replicas (the only kernel-safe size here)
 
     @property
     def majority(self) -> int:
         return self.n_replicas // 2 + 1
+
+    @property
+    def quorum1(self) -> int:
+        """Phase-1 threshold actually compiled into the kernels."""
+        return self.q1 or self.n_replicas // 2 + 1
+
+    @property
+    def quorum2(self) -> int:
+        """Phase-2 (commit) threshold actually compiled into the
+        kernels (quorum_fast supersedes it while fast_path is on)."""
+        return self.q2 or self.n_replicas // 2 + 1
+
+    @property
+    def quorum_fast(self) -> int:
+        """Fast-path commit threshold; see the fast_path field note
+        for why the kernel-safe size is n_replicas."""
+        return self.q_fast or self.n_replicas
 
 
 class MsgBatch(NamedTuple):
@@ -370,7 +415,11 @@ def replica_step_impl(
     """
     S, R = cfg.window, cfg.n_replicas
     M = inbox.kind.shape[0]  # actual batch rows (pending + ext concat)
-    majority = cfg.majority
+    # flexible quorums (config field note): phase-1 sites take q1,
+    # commit scans take q2 — both equal cfg.majority by default; the
+    # fast path commits at quorum_fast (unanimous by default)
+    quorum1 = cfg.quorum1
+    quorum2 = cfg.quorum_fast if cfg.fast_path else cfg.quorum2
     k = inbox.kind
     is_prep = k == int(MsgKind.PREPARE)
     is_prep_reply = k == int(MsgKind.PREPARE_REPLY)
@@ -690,12 +739,23 @@ def replica_step_impl(
                                state.crt_inst))
     state = state._replace(
         prepared=state.prepared
-        | (state.is_leader & (state.prepare_oks.sum() >= majority)),
+        | (state.is_leader & (state.prepare_oks.sum() >= quorum1)),
     )
 
     # ---- 5. PROPOSE (handlePropose :617-710) ----
     can_serve = state.is_leader & state.prepared
-    prop = is_propose & can_serve
+    if cfg.fast_path:
+        # 5-fast (Fast Flexible Paxos, config field note): a follower
+        # that already follows a leader's ballot accepts broadcast
+        # client PROPOSEs straight into its own next slots — sharing
+        # section 5's cumsum assignment and fused slot write B — and
+        # fast-acks the leader (out-row rewrite below) instead of
+        # redirecting the client. The leader keeps its classic path.
+        can_fast = ((~state.is_leader) & (state.leader_id >= 0)
+                    & (state.default_ballot > NO_BALLOT))
+        prop = is_propose & (can_serve | can_fast)
+    else:
+        prop = is_propose & can_serve
     # slot assignment: prefix count over propose rows
     slot_off = jnp.cumsum(prop.astype(jnp.int32)) - 1
     slots = state.crt_inst + slot_off
@@ -757,6 +817,21 @@ def replica_step_impl(
         client_id=jnp.where(is_propose, inbox.client_id, out.client_id),
     )
     dst = jnp.where(fits, -1, jnp.where(reject, -2, dst))  # -2 = to client
+    if cfg.fast_path:
+        # 5-fast out rows: a follower's accepted PROPOSE becomes an
+        # ACCEPT_REPLY to the leader, op=2 marking it a FAST ack whose
+        # vote only counts under the leader's fingerprint check (6),
+        # with the command identity in (client_id, val_lo) and the
+        # run length 1 in cmd_id (range_vote_coverage contract)
+        fastrow = fits & ~state.is_leader
+        out = out._replace(
+            kind=jnp.where(fastrow, int(MsgKind.ACCEPT_REPLY), out.kind),
+            op=jnp.where(fastrow, 2, out.op),
+            cmd_id=jnp.where(fastrow, 1, out.cmd_id),
+            val_hi=jnp.where(fastrow, 0, out.val_hi),
+            val_lo=jnp.where(fastrow, inbox.cmd_id, out.val_lo),
+        )
+        dst = jnp.where(fastrow, state.leader_id, dst)
 
     # ---- 6. ACCEPT_REPLY (handleAcceptReply :1014-1064) ----
     # One reply row acks the RANGE [inst, inst + count) (count in
@@ -768,6 +843,21 @@ def replica_step_impl(
     # clipped to the window contribute their resident part.
     ar_ok = is_accept_reply & (inbox.op > 0) & state.is_leader \
         & (inbox.ballot == state.default_ballot)
+    if cfg.fast_path:
+        # a FAST ack (op == 2) votes only if this leader's own slot
+        # holds the very same command (value fingerprint) at the
+        # serving ballot: a divergent fast assignment must not count
+        # toward a quorum for the leader's value — it converges later
+        # when the classic ACCEPT broadcast overwrites it (section 2
+        # same-ballot overwrite), whose classic re-ack then counts
+        ar_rel = inbox.inst - state.window_base
+        ar_safe = jnp.clip(ar_rel, 0, S - 1)
+        fast_match = ((ar_rel >= 0) & (ar_rel < S)
+                      & (state.status[ar_safe] >= ACCEPTED)
+                      & (state.ballot[ar_safe] == state.default_ballot)
+                      & (state.cmd_id[ar_safe] == inbox.val_lo)
+                      & (state.client_id[ar_safe] == inbox.client_id))
+        ar_ok = ar_ok & ((inbox.op != 2) | fast_match)
     vote_cov = range_vote_coverage(ar_ok, inbox.src, inbox.inst,
                                    inbox.cmd_id, state.window_base, S, R)
     reply_src = jnp.where(is_accept_reply | is_prep_reply,
@@ -798,10 +888,10 @@ def replica_step_impl(
         # acks for exactly the (slot, ballot) pair — per-instance
         # bookkeeping, paxos.go:57-70, :631-660)
         leader_commit = state.is_leader & (state.status == ACCEPTED) & (
-            n_votes >= majority)
+            n_votes >= quorum2)
     else:
         leader_commit = state.is_leader & (state.status == ACCEPTED) & (
-            n_votes >= majority) & (state.ballot == state.default_ballot)
+            n_votes >= quorum2) & (state.ballot == state.default_ballot)
     follower_commit = (state.status == ACCEPTED) & (idx_abs <= lc) & (
         state.ballot == state.default_ballot)
     state = state._replace(
@@ -961,7 +1051,7 @@ def replica_step_impl(
     # value simply hadn't been transferred yet.
     pv_cnt = jax.lax.population_count(
         state.pvotes[rt_rel_safe]).astype(jnp.int32)
-    noop_fill = rt_empty & (pv_cnt >= majority)
+    noop_fill = rt_empty & (pv_cnt >= quorum1)
     # A slot holding a value adopted from phase-1 answers (ballot !=
     # default_ballot) may be re-driven at the current ballot ONLY after
     # a majority answered the per-instance phase 1: the adopted value
@@ -972,7 +1062,7 @@ def replica_step_impl(
     # the current ballot were driven by this leader (safe); committed
     # slots carry the decided value (safe).
     own_ballot = state.ballot[rt_rel_safe] == state.default_ballot
-    settled = (pv_cnt >= majority) | (state.status[rt_rel_safe] >= COMMITTED)
+    settled = (pv_cnt >= quorum1) | (state.status[rt_rel_safe] >= COMMITTED)
     rt_ok = rt_in & (
         ((state.status[rt_rel_safe] >= ACCEPTED) & (own_ballot | settled))
         | noop_fill)

@@ -416,18 +416,48 @@ def stall_alarm(samples: list[dict], stall_s: float = 1.0,
     the music stopped) has the leader's connectivity as the common
     cause: the isolated-leader chaos schedule's exact signature.
     Every frontier flat and level also blames the leader — nobody
-    commits without it reaching a quorum."""
+    commits without it reaching a quorum.
+
+    A moving tip is not automatically healthy either: a strict
+    minority whose own frontier stayed FLAT while the tip pulled away
+    beyond ``lag_slots`` is a scoped stall — under flexible quorums a
+    partitioned q2-sized island starves exactly like this while the
+    majority side commits on without it (the flex_partition chaos
+    schedule's signature) — and is blamed by name."""
     win = _window(samples, stall_s)
     if not win:
         return None
     tip_delta = win[-1]["tip"] - win[0]["tip"]
     prop_delta = win[-1]["proposals"] - win[0]["proposals"]
     active = win[-1]["in_flight"] > 0 or prop_delta > 0
-    if tip_delta > slack_slots or not active:
+    if not active:
         return None
     last = win[-1]
     lags = {int(rid): last["tip"] - r["frontier"]
             for rid, r in last["replicas"].items() if r["ok"]}
+    if tip_delta > slack_slots:
+        first_fr = {int(rid): r["frontier"]
+                    for rid, r in win[0]["replicas"].items() if r["ok"]}
+        last_fr = {int(rid): r["frontier"]
+                   for rid, r in last["replicas"].items() if r["ok"]}
+        starved = [rid for rid, fr in last_fr.items()
+                   if rid in first_fr
+                   and fr - first_fr[rid] <= slack_slots
+                   and lags.get(rid, 0) > lag_slots]
+        if starved and len(starved) < len(last_fr) // 2 + 1:
+            suspect = max(starved, key=lags.get)
+            return {
+                "detector": "frontier_stall", "subject": suspect,
+                "evidence": {
+                    "window_s": round(last["t"] - win[0]["t"], 3),
+                    "tip_delta": tip_delta,
+                    "proposals_delta": prop_delta,
+                    "in_flight": last["in_flight"],
+                    "lags": lags,
+                    "why": (f"replica {suspect} starved of commits: "
+                            f"frontier flat while the tip advanced "
+                            f"{tip_delta} slots (lag {lags[suspect]})")}}
+        return None
     suspect = int(last["leader"])
     why = "leader cannot reach a quorum (every frontier flat)"
     lagging = [rid for rid, lag in lags.items() if lag > lag_slots]

@@ -116,7 +116,8 @@ class Bounds:
 
 
 def model_config(protocol: str, majority_override: int | None = None,
-                 n_replicas: int = 3) -> MinPaxosConfig:
+                 n_replicas: int = 3, q1: int = 0,
+                 q2: int = 0) -> MinPaxosConfig:
     """The small-configuration protocol config the checker drives.
 
     window=8 holds every slot the bounded runs can touch with the
@@ -128,6 +129,12 @@ def model_config(protocol: str, majority_override: int | None = None,
     explorers jit via per-instance closures, never via shared
     static-argnum caches, so an overridden config can never collide
     with a healthy one.
+
+    ``q1``/``q2`` set the FLEXIBLE quorum fields directly (0 = the
+    majority default) — the certified path (verified legs) and the
+    planted non-intersecting-pair mutant (``tools/mc.py --mutant
+    flex-broken``) both go through the real config fields the kernels
+    compile, with no host-side ``validate_config_quorums`` in the way.
     """
     if protocol not in PROTOCOLS:
         raise ValueError(f"unknown protocol {protocol!r}; "
@@ -135,12 +142,17 @@ def model_config(protocol: str, majority_override: int | None = None,
     base = dict(
         n_replicas=n_replicas, window=8, inbox=8, exec_batch=4,
         kv_pow2=3, catchup_rows=2, recovery_rows=2, noop_delay=2,
-        slide_window=False, gossip_ticks=1,
+        slide_window=False, gossip_ticks=1, q1=q1, q2=q2,
         explicit_commit=(protocol == "classic"))
     if majority_override is None:
         return MinPaxosConfig(**base)
     cls = type("MutantQuorumConfig", (MinPaxosConfig,), {
+        # override every threshold view: the legacy `majority` (what
+        # tests pin) and the quorum1/quorum2 properties the kernels
+        # now actually read (flexible-quorum sites)
         "majority": property(lambda self: majority_override),
+        "quorum1": property(lambda self: majority_override),
+        "quorum2": property(lambda self: majority_override),
         "__doc__": "MinPaxosConfig with a seeded quorum threshold",
     })
     return cls(**base)
@@ -165,11 +177,19 @@ class Counterexample:
     trace: list[dict]
     report: dict
     states_explored: int = 0
+    # flexible-quorum config (0/0 = majority defaults; replay rebuilds
+    # the exact mutant config from these) — optional in the format so
+    # pre-flexible fixtures keep loading
+    q1: int = 0
+    q2: int = 0
+    n_replicas: int = 3
 
     def to_dict(self) -> dict:
         return {"format": CE_FORMAT, "protocol": self.protocol,
                 "bounds": self.bounds.to_dict(),
                 "majority_override": self.majority_override,
+                "q1": self.q1, "q2": self.q2,
+                "n_replicas": self.n_replicas,
                 "trace": self.trace, "report": self.report,
                 "states_explored": self.states_explored}
 
@@ -180,6 +200,8 @@ class Counterexample:
                              f"format={d.get('format')!r}")
         return cls(protocol=d["protocol"], bounds=Bounds(**d["bounds"]),
                    majority_override=d.get("majority_override"),
+                   q1=int(d.get("q1", 0)), q2=int(d.get("q2", 0)),
+                   n_replicas=int(d.get("n_replicas", 3)),
                    trace=list(d["trace"]), report=dict(d["report"]),
                    states_explored=int(d.get("states_explored", 0)))
 
@@ -189,6 +211,9 @@ class McResult:
     protocol: str
     bounds: Bounds
     majority_override: int | None
+    q1: int = 0
+    q2: int = 0
+    n_replicas: int = 3
     states: int = 0
     transitions: int = 0
     max_depth_seen: int = 0
@@ -205,6 +230,8 @@ class McResult:
     def to_dict(self) -> dict:
         return {"protocol": self.protocol, "bounds": self.bounds.to_dict(),
                 "majority_override": self.majority_override,
+                "q1": self.q1, "q2": self.q2,
+                "n_replicas": self.n_replicas,
                 "states": self.states, "transitions": self.transitions,
                 "max_depth_seen": self.max_depth_seen,
                 "drained": self.drained,
@@ -219,11 +246,14 @@ class Explorer:
     """One bounded exhaustive exploration of one protocol."""
 
     def __init__(self, protocol: str, bounds: Bounds | None = None,
-                 majority_override: int | None = None):
+                 majority_override: int | None = None, q1: int = 0,
+                 q2: int = 0, n_replicas: int = 3):
         self.protocol = protocol
         self.bounds = bounds or Bounds()
         self.majority_override = majority_override
-        self.cfg = model_config(protocol, majority_override)
+        self.q1, self.q2 = q1, q2
+        self.cfg = model_config(protocol, majority_override,
+                                n_replicas=n_replicas, q1=q1, q2=q2)
         self.R = self.cfg.n_replicas
         if protocol == "mencius":
             self._init, step_impl = init_mencius, mencius_step_impl
@@ -447,14 +477,16 @@ class Explorer:
     def run(self, log=None) -> McResult:
         """Breadth-first exhaustive exploration within the bounds."""
         b = self.bounds
-        res = McResult(self.protocol, b, self.majority_override)
+        res = McResult(self.protocol, b, self.majority_override,
+                       q1=self.q1, q2=self.q2, n_replicas=self.R)
         t0 = time.monotonic()
         root = self.initial()
         report = self.check_invariants(root[0])
         if not report.ok:  # a broken initial state: depth-0 violation
             res.counterexample = Counterexample(
                 self.protocol, b, self.majority_override, [],
-                report.to_dict())
+                report.to_dict(), q1=self.q1, q2=self.q2,
+                n_replicas=self.R)
             res.wall_s = time.monotonic() - t0
             return res
         seen = {self._key(root)}
@@ -494,7 +526,8 @@ class Explorer:
                     trace.reverse()
                     res.counterexample = Counterexample(
                         self.protocol, b, self.majority_override, trace,
-                        report.to_dict(), states_explored=res.states)
+                        report.to_dict(), states_explored=res.states,
+                        q1=self.q1, q2=self.q2, n_replicas=self.R)
                     res.wall_s = time.monotonic() - t0
                     return res
                 if res.states >= b.max_states:
@@ -528,7 +561,8 @@ def replay_counterexample(ce: Counterexample | dict,
     """
     if isinstance(ce, dict):
         ce = Counterexample.from_dict(ce)
-    ex = Explorer(ce.protocol, ce.bounds, ce.majority_override)
+    ex = Explorer(ce.protocol, ce.bounds, ce.majority_override,
+                  q1=ce.q1, q2=ce.q2, n_replicas=ce.n_replicas)
     node = ex.initial()
     report = ex.check_invariants(node[0])
     if not report.ok:
@@ -562,7 +596,8 @@ def counterexample_faultplan(ce: Counterexample | dict,
         ce = Counterexample.from_dict(ce)
     from minpaxos_tpu.chaos.plan import FaultPlan
 
-    ex = Explorer(ce.protocol, ce.bounds, ce.majority_override)
+    ex = Explorer(ce.protocol, ce.bounds, ce.majority_override,
+                  q1=ce.q1, q2=ce.q2, n_replicas=ce.n_replicas)
     node = ex.initial()
     blocked: set[tuple[int, int]] = set()
     for action in ce.trace:

@@ -180,6 +180,69 @@ def verify_certificate(cert: Certificate) -> bool:
 
 
 def majority(n: int) -> int:
-    """The threshold actually compiled into the kernels
+    """The default threshold compiled into the kernels
     (``MinPaxosConfig.majority``): q = n // 2 + 1, both phases."""
     return n // 2 + 1
+
+
+def certify_fast(n: int, q1: int, qf: int) -> Certificate:
+    """Fast Flexible Paxos fast-quorum certificate (PAPERS.md
+    2008.02671): a fast quorum Qf is safe iff any two fast quorums
+    intersect within every phase-1 quorum — for threshold systems,
+    |Qf ∩ Qf' ∩ Q1| >= 2*qf + q1 - 2n >= 1, i.e. 2*qf + q1 > 2n
+    (classic Fast Paxos' qf = ceil(3n/4) is the q1 = majority special
+    case). Refutations carry a witness (Qf, Qf') pair whose overlap
+    misses a Q1. NOTE: the shipped kernel additionally restricts
+    qf = n (models/minpaxos.py fast_path field note — its index-
+    tiebreak phase-1 adoption needs the committed value on every
+    replica); this certificate proves the general condition."""
+    if not (1 <= q1 <= n and 1 <= qf <= n):
+        raise ValueError(
+            f"degenerate quorum thresholds for n={n}: q1={q1}, qf={qf} "
+            f"(must satisfy 1 <= q <= n)")
+    if 2 * qf + q1 > 2 * n:
+        return Certificate(
+            "fast-threshold", n, q1, qf, True,
+            f"|Qf ∩ Qf' ∩ Q1| >= 2*qf + q1 - 2n = {2 * qf + q1 - 2 * n}"
+            f" >= 1 for every Qf, Qf', Q1")
+    a = tuple(range(qf))
+    b = tuple(range(n - qf, n))
+    return Certificate(
+        "fast-threshold", n, q1, qf, False,
+        f"2*qf + q1 = {2 * qf + q1} <= 2n = {2 * n}: two fast quorums "
+        f"can overlap outside some phase-1 quorum",
+        witness=(a, b))
+
+
+def validate_config_quorums(cfg) -> Certificate:
+    """Certify the quorums a config would compile into the kernels, or
+    raise ``ValueError`` with the refutation witness. Called by the
+    host-side constructors (models/cluster.py, cli/server.py, the
+    chaos harness) — NOT by the kernels or the model checker, which
+    must be able to run planted non-intersecting mutants
+    (verify/mc.py). Duck-typed: anything with ``n_replicas``/
+    ``quorum1``/``quorum2`` (MinPaxosConfig) works."""
+    n = cfg.n_replicas
+    q1, q2 = cfg.quorum1, cfg.quorum2
+    cert = certify_threshold(n, q1, q2)
+    if not cert.intersects:
+        raise ValueError(
+            f"non-intersecting quorum config n={n}, q1={q1}, q2={q2}: "
+            f"{cert.reason}; witness quorums {cert.witness} commit "
+            f"split-brain under partition")
+    if getattr(cfg, "fast_path", False):
+        if getattr(cfg, "explicit_commit", False):
+            raise ValueError("fast_path supports the minpaxos kernel "
+                             "only (explicit_commit must be False)")
+        qf = cfg.quorum_fast
+        if qf != n:
+            raise ValueError(
+                f"fast_path with q_fast={qf} != n={n}: the kernel's "
+                f"index-tiebreak phase-1 adoption is only safe at "
+                f"unanimous fast quorums (fast_path field note)")
+        fcert = certify_fast(n, q1, qf)
+        if not fcert.intersects:
+            raise ValueError(
+                f"fast quorum refuted for n={n}, q1={q1}, qf={qf}: "
+                f"{fcert.reason}")
+    return cert

@@ -1,0 +1,197 @@
+"""Flexible-quorum (q1, q2) behavior tests (PR 16).
+
+Flexible Paxos (PAPERS.md 1608.06696): safety needs only that every
+phase-1 quorum intersects every phase-2 quorum — q1 + q2 > n for
+threshold systems — not that both be majorities. These tests pin the
+three contracts the config fields introduce:
+
+* **default identity**: an EXPLICIT (q1, q2) = (majority, majority)
+  compiles byte-identically to the 0-sentinel default — verified
+  against the very same PR-15 golden digests test_kernel_golden.py
+  pins, for all three protocols.
+* **threshold semantics**: commits land at exactly q2 live acceptors
+  (where a majority config stalls), and elections complete at exactly
+  q1 promises (and not below).
+* **fast path** (Fast Flexible Paxos, 2008.02671): broadcast client
+  proposals commit exactly-once with cross-replica agreement even when
+  divergent follower slot assignments force the value-fingerprint
+  fallback to the classic path.
+
+Plus the host-side gate: non-intersecting configs must be refused by
+construction (verify/quorum.py), with the refutation witness in the
+error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.models.cluster import Cluster, tree_slice
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig
+from minpaxos_tpu.verify.quorum import validate_config_quorums
+from minpaxos_tpu.wire.messages import Op
+from tests.test_kernel_golden import _KW, FIXTURE, PROTOCOLS, _drive
+
+# the golden scenario's shape (n=5), with quorums made explicit: at
+# n=5 the majority is 3, so (3, 3) must resolve to the exact
+# thresholds the 0-sentinel default compiles
+_MAJ = _KW["n_replicas"] // 2 + 1
+
+
+@pytest.mark.parametrize("protocol", PROTOCOLS)
+def test_explicit_majority_matches_golden_digests(protocol):
+    """(q1, q2) = (majority, majority) spelled out is byte-identical
+    to the recorded default: every per-step full-state digest of the
+    golden scenario matches the PR-15 fixture unmodified."""
+    with open(FIXTURE) as f:
+        golden = json.load(f)
+    got = _drive(protocol, extra_cfg={"q1": _MAJ, "q2": _MAJ})
+    want = golden[protocol]
+    assert len(got) == len(want)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g == w, (
+            f"{protocol}: explicit (q1, q2) = ({_MAJ}, {_MAJ}) diverged "
+            f"from the 0-sentinel default at step {i} — the sentinel "
+            f"resolution is no longer an identity")
+
+
+def _boot5(q1: int = 0, q2: int = 0) -> Cluster:
+    c = Cluster(MinPaxosConfig(**dict(_KW, q1=q1, q2=q2)), ext_rows=8)
+    c.elect(0)
+    c.run(3)
+    assert bool(np.asarray(tree_slice(c.cs.states, 0).prepared))
+    return c
+
+
+def _put_batch(c: Cluster, n: int, client: int, to=None):
+    c.propose(ops=[Op.PUT] * n, keys=list(range(n)),
+              vals=[k * 7 for k in range(n)], cmd_ids=list(range(n)),
+              client_id=client, to=to)
+
+
+def test_commit_at_q2_survives_majority_loss():
+    """n=5, (q1, q2) = (4, 2): with three non-leaders dead (2 live <
+    majority), a q2-sized vote set still commits — the very acks the
+    flexible config removes from the critical path."""
+    c = _boot5(q1=4, q2=2)
+    for r in (2, 3, 4):
+        c.kill(r)
+    _put_batch(c, 8, client=1)
+    c.run(6)
+    assert len(c.replies) == 8
+    for i in range(8):
+        assert c.replies[(1, i)]["value"] == i * 7
+    assert int(np.asarray(tree_slice(c.cs.states, 0).committed_upto)) >= 7
+
+
+def test_majority_config_stalls_where_q2_commits():
+    """Control for the previous test: the SAME scenario under an
+    explicit majority config (q2=3) must stall — 2 live replicas
+    cannot assemble 3 votes, so nothing commits and nothing replies."""
+    c = _boot5(q1=_MAJ, q2=_MAJ)
+    for r in (2, 3, 4):
+        c.kill(r)
+    _put_batch(c, 8, client=1)
+    c.run(6)
+    assert not c.replies
+    assert int(np.asarray(tree_slice(c.cs.states, 0).committed_upto)) < 7
+
+
+def test_leader_change_requires_q1_promises():
+    """n=5, q1=4: an election with only 3 replicas alive must NOT
+    complete (3 < q1); after reviving a fourth, the same candidate's
+    next Prepare round gathers q1 promises and prepares."""
+    cfg = MinPaxosConfig(**dict(_KW, q1=4, q2=2))
+    c = Cluster(cfg, ext_rows=8)
+    c.kill(3)
+    c.kill(4)
+    c.elect(1)
+    c.run(4)
+    st1 = tree_slice(c.cs.states, 1)
+    assert not bool(np.asarray(st1.prepared)), (
+        "prepared with 3 promises under q1=4 — phase-1 gate is not "
+        "taking cfg.quorum1")
+    c.revive(3)
+    c.elect(1)  # fresh Prepare round reaches the revived replica
+    c.run(4)
+    st1 = tree_slice(c.cs.states, 1)
+    assert bool(np.asarray(st1.prepared))
+
+
+def test_fast_path_broadcast_commits_exactly_once():
+    """n=3 fast path: unicast rows put the leader's slot cursor AHEAD
+    of the followers', so the immediately-broadcast batch gets
+    divergent follower assignments — their fast-acks fail the leader's
+    value-fingerprint check and the classic ACCEPT path must converge
+    everything. Contract: every proposal commits exactly once, GETs
+    observe the writes, and all replicas agree on the committed log."""
+    cfg = MinPaxosConfig(n_replicas=3, window=256, inbox=512,
+                         exec_batch=128, kv_pow2=10, fast_path=True)
+    c = Cluster(cfg, ext_rows=256)
+    c.elect(0)
+    c.run(3)
+    # unicast advances the leader's crt_inst; the broadcast lands on
+    # followers still at the old cursor -> fingerprint mismatch path
+    c.propose(ops=[Op.PUT] * 10, keys=list(range(10)),
+              vals=[k + 100 for k in range(10)],
+              cmd_ids=list(range(10)), client_id=1, to=0)
+    c.propose(ops=[Op.PUT] * 10, keys=list(range(10, 20)),
+              vals=[k + 100 for k in range(10, 20)],
+              cmd_ids=list(range(10, 20)), client_id=1, to=-1)
+    c.run(8)
+    assert len(c.replies) == 20
+    assert not [e for e in c.reply_log if e.get("duplicate")]
+    for i in range(20):
+        assert c.replies[(1, i)]["value"] == i + 100
+    # reads observe every write (broadcast too: the happy 1-RTT shape)
+    c.propose(ops=[Op.GET] * 20, keys=list(range(20)), vals=[0] * 20,
+              cmd_ids=list(range(20, 40)), client_id=1, to=-1)
+    c.run(8)
+    for i in range(20):
+        rep = c.replies[(1, 20 + i)]
+        assert rep["found"] and rep["value"] == i + 100
+    # cross-replica agreement on the co-resident committed prefix
+    frontiers, bases, logs = [], [], []
+    for r in range(3):
+        st = tree_slice(c.cs.states, r)
+        frontiers.append(int(np.asarray(st.committed_upto)))
+        bases.append(int(np.asarray(st.window_base)))
+        logs.append((np.asarray(st.op), np.asarray(st.key_lo),
+                     np.asarray(st.cmd_id), np.asarray(st.client_id)))
+    assert min(frontiers) == max(frontiers) >= 39
+    lo, hi = max(bases), min(frontiers) + 1
+    assert hi - lo > 0
+    for r in range(1, 3):
+        for a, b in zip(logs[0], logs[r]):
+            np.testing.assert_array_equal(
+                a[lo - bases[0]:hi - bases[0]],
+                b[lo - bases[r]:hi - bases[r]])
+
+
+def test_non_intersecting_config_refused():
+    """q1 + q2 <= n must be refused at construction with the witness
+    pair in the error — before any kernel could compile it."""
+    bad = MinPaxosConfig(**dict(_KW, q1=2, q2=2))  # 4 <= 5
+    with pytest.raises(ValueError, match="witness"):
+        validate_config_quorums(bad)
+    with pytest.raises(ValueError, match="non-intersecting"):
+        Cluster(bad, ext_rows=8)
+    # certified pairs construct fine (no kernel run: just the gate)
+    for q1, q2 in ((4, 2), (2, 4), (5, 1), (1, 5)):
+        validate_config_quorums(MinPaxosConfig(**dict(_KW, q1=q1, q2=q2)))
+
+
+def test_fast_path_requires_unanimous_fast_quorum():
+    """The kernel's index-tiebreak phase-1 adoption is only safe at
+    q_fast = n (models/minpaxos.py field note): any smaller explicit
+    fast quorum must be refused even though the GENERAL Fast Flexible
+    Paxos condition might hold for it."""
+    bad = MinPaxosConfig(**dict(_KW, fast_path=True, q_fast=4))
+    with pytest.raises(ValueError, match="q_fast"):
+        validate_config_quorums(bad)
+    validate_config_quorums(
+        MinPaxosConfig(**dict(_KW, fast_path=True)))  # qf defaults to n

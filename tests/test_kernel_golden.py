@@ -56,13 +56,19 @@ def _digest(cs) -> str:
     return h.hexdigest()
 
 
-def _drive(protocol: str) -> list[str]:
-    """Deterministic mixed-traffic scenario; one digest per step."""
+def _drive(protocol: str, extra_cfg: dict | None = None) -> list[str]:
+    """Deterministic mixed-traffic scenario; one digest per step.
+
+    ``extra_cfg`` merges extra MinPaxosConfig fields into the golden
+    shape — test_flexible_quorum.py uses it to pin that an EXPLICIT
+    (q1, q2) = (majority, majority) compiles byte-identically to the
+    0-sentinel default recorded in the fixture."""
+    kw = dict(_KW, **(extra_cfg or {}))
     if protocol == "mencius":
-        cl = MenciusCluster(MinPaxosConfig(**_KW), ext_rows=8)
+        cl = MenciusCluster(MinPaxosConfig(**kw), ext_rows=8)
     else:
-        cfg = (classic_config(**_KW) if protocol == "classic"
-               else MinPaxosConfig(**_KW))
+        cfg = (classic_config(**kw) if protocol == "classic"
+               else MinPaxosConfig(**kw))
         cl = Cluster(cfg, ext_rows=8)
     rng = np.random.default_rng(7)
     digests = []

@@ -592,9 +592,12 @@ def test_quorum_certificate_quiet_on_certified_majority():
 
 
 def test_quorum_certificate_flags_uncovered_and_literal():
-    # intersecting but absent from the ledger: must be appended
+    # intersecting but absent from the ledger: must be appended.
+    # (q = n itself became a certified formula when quorum_fast landed,
+    # so probe with ceil(3n/4) — fast-paxos-ish, intersects with
+    # itself, but (4, 4) at n=5 is not a ledger row)
     src = ("class C:\n    @property\n    def quorum(self):\n"
-           "        return self.n_replicas - 0\n")  # q = n: intersects
+           "        return (self.n_replicas * 3 + 3) // 4\n")
     vs = lint_src("minpaxos_tpu/models/u.py", src, "quorum-certificate")
     assert any("not covered by a certified entry" in v.msg for v in vs), vs
     # fixed literal compared against a vote count

@@ -54,8 +54,13 @@ def _smoke_legs():
     # without the election budget (that machinery is shared and was
     # exhausted in leg 1); leg 3 gives Mencius two concurrent owners
     # (the SKIP/cede interleavings that are its novel risk) at depth 4.
-    # Sized so legs 2+3+mutant stay well under the budget even at the
-    # 1-core host's slow-tide speeds (VERIFY.md has measured counts).
+    # Leg 4 is the FLEXIBLE-quorum leg (ISSUE 16): q1=3/q2=1 at N=3 —
+    # a unanimous phase 1 buying single-ack commits, the extreme
+    # certified point of the q1+q2>N family — one drop, no election
+    # budget (a q1=3 re-election can't complete inside these depths
+    # anyway). Sized so legs 2+3+4+mutants stay well under the budget
+    # even at the 1-core host's slow-tide speeds (VERIFY.md).
+    # Legs are (label, protocol, bounds, explorer_kwargs).
     minpaxos = Bounds(max_depth=5, drops=1, dups=1, internal=1,
                       elections=1, electable=(1,), n_cmds=2,
                       propose_to=(0,))
@@ -63,8 +68,13 @@ def _smoke_legs():
                      elections=0, n_cmds=2, propose_to=(0,))
     mencius = Bounds(max_depth=4, drops=1, dups=1, internal=1,
                      elections=0, n_cmds=1, propose_to=(0, 1))
-    return [("minpaxos", minpaxos, None), ("classic", classic, None),
-            ("mencius", mencius, None)]
+    flex = Bounds(max_depth=5, drops=1, dups=0, internal=1,
+                  elections=0, n_cmds=2, propose_to=(0,))
+    return [("minpaxos", "minpaxos", minpaxos, {}),
+            ("classic", "classic", classic, {}),
+            ("mencius", "mencius", mencius, {}),
+            ("minpaxos-flex-q1=3-q2=1", "minpaxos", flex,
+             {"q1": 3, "q2": 1})]
 
 
 def _mutant_bounds():
@@ -74,6 +84,53 @@ def _mutant_bounds():
     # two-leaders split-brain to appear within depth 6
     return Bounds(max_depth=6, drops=2, dups=0, internal=1, elections=1,
                   electable=(1,), n_cmds=2, propose_to=(0, 1))
+
+
+#: the planted non-intersecting FLEXIBLE pair (q1 + q2 = 3 <= N = 3):
+#: q1=2 lets a second leader elect off one reply while q2=1 commits on
+#: a leader's own accept — both ingress queues + one election is all
+#: the schedule freedom the split-brain needs
+FLEX_MUTANT = {"q1": 2, "q2": 1}
+
+
+def _flex_mutant_bounds():
+    from minpaxos_tpu.verify.mc import Bounds
+
+    # no drops or ticks needed: the two leaders never lose a frame,
+    # they just commit slot 0 from different ingress queues before
+    # hearing each other — commit at 0, elect 1 off replica 2's reply
+    # (its PREPARE_REPLY precedes the ACCEPT in no FIFO order), commit
+    # again at 1. The known counterexample is 8 deliveries deep
+    # (tests/fixtures/mc_flex_broken_minpaxos.json)
+    return Bounds(max_depth=8, drops=0, dups=0, internal=0, elections=1,
+                  electable=(1,), n_cmds=2, propose_to=(0, 1))
+
+
+def _flex_certified_runs(log=print):
+    """One bounded exploration per certified (q1, q2) ledger pair at
+    N=3..5 (GOLDEN_THRESHOLDS), minpaxos kernel: BFS must drain with 0
+    violations for every pair. Bounds shrink with N (the link count
+    grows the branching factor) — each leg still reaches commits for
+    the small-q2 pairs, and every reached state is invariant-checked."""
+    from minpaxos_tpu.analysis.quorum_golden import GOLDEN_THRESHOLDS
+    from minpaxos_tpu.verify.mc import Bounds, Explorer
+
+    runs = []
+    for n in (3, 4, 5):
+        b = Bounds(max_depth=5 if n == 3 else 4,
+                   drops=1 if n == 3 else 0, dups=0,
+                   internal=1 if n == 3 else 0, elections=0,
+                   n_cmds=2 if n == 3 else 1, propose_to=(0,))
+        for q1, q2 in GOLDEN_THRESHOLDS.get(n, ()):
+            log(f"[paxmc] flex-certified: n={n} q1={q1} q2={q2} "
+                f"(depth {b.max_depth}) ...")
+            res = Explorer("minpaxos", b, q1=q1, q2=q2,
+                           n_replicas=n).run()
+            runs.append(res)
+            log(f"[paxmc]   -> {'ok' if res.ok else 'VIOLATION'} "
+                f"states={res.states} drained={res.drained} "
+                f"wall={res.wall_s:.1f}s")
+    return runs
 
 
 def _print_quorum_golden() -> int:
@@ -146,10 +203,23 @@ def main(argv=None) -> int:
     p.add_argument("--dups", type=int, default=None)
     p.add_argument("--reorders", type=int, default=None)
     p.add_argument("--internal", type=int, default=None)
-    p.add_argument("--mutant", choices=["broken-quorum"], default=None,
-                   help="seeded mutant: quorum threshold forced to 1 "
-                        "(non-intersecting at N=3); exit 0 iff the "
-                        "counterexample is found")
+    p.add_argument("--mutant", choices=["broken-quorum", "flex-broken"],
+                   default=None,
+                   help="seeded mutant: 'broken-quorum' forces the "
+                        "threshold to 1 via the property override; "
+                        "'flex-broken' plants the non-intersecting "
+                        f"flexible pair {FLEX_MUTANT} through the real "
+                        "cfg.q1/cfg.q2 fields. Exit 0 iff the "
+                        "counterexample is found and replays")
+    p.add_argument("--q1", type=int, default=0,
+                   help="flexible phase-1 quorum (0 = majority)")
+    p.add_argument("--q2", type=int, default=0,
+                   help="flexible phase-2 quorum (0 = majority)")
+    p.add_argument("--n", type=int, default=3, help="model replicas")
+    p.add_argument("--flex-certified", action="store_true",
+                   help="explore every certified GOLDEN_THRESHOLDS "
+                        "(q1,q2) pair at N=3..5 (minpaxos); exit 0 iff "
+                        "all drain with 0 violations")
     p.add_argument("--replay", default=None, metavar="CE_JSON",
                    help="replay a counterexample trace; exit 0 iff the "
                         "violation reproduces")
@@ -209,10 +279,25 @@ def main(argv=None) -> int:
         from dataclasses import replace
         return replace(b, **kw) if kw else b
 
+    if args.flex_certified:
+        runs = _flex_certified_runs()
+        ok = all(r.ok and r.drained for r in runs)
+        verdict = {"ok": ok, "flex_certified": True,
+                   "runs": [r.to_dict() for r in runs]}
+        print(f"[paxmc] flex-certified verdict: "
+              f"{json.dumps({'ok': ok, 'pairs': len(runs)})}", flush=True)
+        if args.json:
+            Path(args.json).write_text(json.dumps(verdict, indent=1))
+        return 0 if ok else 1
+
     if args.mutant:
-        b = override(_mutant_bounds())
         proto = "minpaxos" if args.protocol == "all" else args.protocol
-        res = Explorer(proto, b, majority_override=1).run(log=print)
+        if args.mutant == "flex-broken":
+            b = override(_flex_mutant_bounds())
+            res = Explorer(proto, b, **FLEX_MUTANT).run(log=print)
+        else:
+            b = override(_mutant_bounds())
+            res = Explorer(proto, b, majority_override=1).run(log=print)
         found = res.counterexample is not None
         line = {"mutant": args.mutant, "protocol": proto,
                 "counterexample_found": found, "states": res.states,
@@ -236,18 +321,25 @@ def main(argv=None) -> int:
     if args.protocol != "all":
         if args.protocol not in PROTOCOLS:
             p.error(f"unknown protocol {args.protocol!r}")
-        legs = [l for l in legs if l[0] == args.protocol]
-    legs = [(proto, override(b), mut) for proto, b, mut in legs]
+        legs = [l for l in legs if l[1] == args.protocol]
+    if args.q1 or args.q2 or args.n != 3:
+        # ad-hoc flexible run: one leg per selected protocol at the
+        # requested (n, q1, q2)
+        legs = [(f"{label}-n={args.n}-q1={args.q1}-q2={args.q2}", proto,
+                 b, dict(kw, q1=args.q1, q2=args.q2, n_replicas=args.n))
+                for label, proto, b, kw in legs[:1]] or legs
+    legs = [(label, proto, override(b), kw)
+            for label, proto, b, kw in legs]
 
     t_start = time.monotonic()
     t_budget = None
     runs = []
     ok = True
-    for proto, b, mut in legs:
-        print(f"[paxmc] exploring {proto} (depth {b.max_depth}, "
+    for label, proto, b, kw in legs:
+        print(f"[paxmc] exploring {label} (depth {b.max_depth}, "
               f"{b.n_cmds} cmds, drops {b.drops}, dups {b.dups}) ...",
               flush=True)
-        res = Explorer(proto, b, majority_override=mut).run(log=print)
+        res = Explorer(proto, b, **kw).run(log=print)
         if t_budget is None:
             t_budget = time.monotonic()  # first run covered jit compile
         runs.append(res)
@@ -277,6 +369,21 @@ def main(argv=None) -> int:
             "states": res.states, "wall_s": round(res.wall_s, 1),
             "trace_len": (len(res.counterexample.trace) if found else 0)}
         ok = ok and found and reproduced
+        # same contract for the FLEXIBLE mutant: the planted
+        # non-intersecting (q1, q2) pair — through the real config
+        # fields, not the property override — must also be found and
+        # replayed, or the flexible legs above prove nothing
+        fres = Explorer("minpaxos", _flex_mutant_bounds(),
+                        **FLEX_MUTANT).run()
+        ffound = fres.counterexample is not None
+        freproduced = ffound and replay_counterexample(
+            fres.counterexample.to_dict())[0]
+        verdict["flex_mutant_self_test"] = {
+            "q1": FLEX_MUTANT["q1"], "q2": FLEX_MUTANT["q2"],
+            "found": ffound, "replay_reproduced": freproduced,
+            "states": fres.states, "wall_s": round(fres.wall_s, 1),
+            "trace_len": (len(fres.counterexample.trace) if ffound else 0)}
+        ok = ok and ffound and freproduced
         checked_wall = time.monotonic() - (t_budget or t_start)
         verdict["budget_s"] = SMOKE_BUDGET_S
         verdict["within_budget"] = checked_wall <= SMOKE_BUDGET_S
@@ -300,6 +407,8 @@ def main(argv=None) -> int:
             "wall_s": verdict["wall_s"]}
     if args.smoke:
         line["mutant_self_test"] = verdict["mutant_self_test"]["found"]
+        line["flex_mutant_self_test"] = (
+            verdict["flex_mutant_self_test"]["found"])
     print(f"[paxmc] verdict: {json.dumps(line)}", flush=True)
     if args.json:
         Path(args.json).write_text(json.dumps(verdict, indent=1))

@@ -61,11 +61,11 @@ from minpaxos_tpu.parallel.sharded import ShardedCluster  # noqa: E402
 
 
 def point_config(protocol: str, w: int, p: int, inbox: int | None = None,
-                 compact: int = 0) -> MinPaxosConfig:
+                 compact: int = 0, q1: int = 0, q2: int = 0) -> MinPaxosConfig:
     cu = cpu_catchup_rows(p, fault=False)
     kw = dict(n_replicas=5, window=w, inbox=p + 2 * cu + 64 + 64,
               exec_batch=p, kv_pow2=cpu_kv_pow2(p), catchup_rows=cu,
-              recovery_rows=64, compact_inbox=compact)
+              recovery_rows=64, compact_inbox=compact, q1=q1, q2=q2)
     if protocol == "classic":
         if inbox is not None:
             kw["inbox"] = inbox
@@ -94,7 +94,8 @@ def adaptive_capacity(hwm: int) -> int:
 def measure_point(protocol: str, g: int, w: int, p: int, k: int,
                   dispatches: int = 3, key_space: int | None = None,
                   shard_devices: int = 1, seed: int = 0,
-                  inbox: int | None = None, compact: int = 0) -> dict:
+                  inbox: int | None = None, compact: int = 0,
+                  q1: int = 0, q2: int = 0) -> dict:
     """Time the resident loop at one (g, w, p, k) point: warm one
     dispatch, run ``dispatches`` back-to-back (two-scalar readbacks
     only), then drain and REQUIRE exactness (in-flight == 0) — a point
@@ -109,7 +110,8 @@ def measure_point(protocol: str, g: int, w: int, p: int, k: int,
     dropped (total commits == total injected; minpaxos/classic only —
     Mencius frontiers count SKIP no-op slots, so drained_exact is its
     contract)."""
-    cfg = point_config(protocol, w, p, inbox=inbox, compact=compact)
+    cfg = point_config(protocol, w, p, inbox=inbox, compact=compact,
+                       q1=q1, q2=q2)
     if key_space is None:
         key_space = cpu_key_space(p)
     mesh = None
@@ -152,6 +154,9 @@ def measure_point(protocol: str, g: int, w: int, p: int, k: int,
         "protocol": protocol,
         "g": g, "w": w, "p": p, "k": k,
         "shard_devices": shard_devices,
+        # resolved flexible-quorum sizes (PR 16): default = majority
+        "q1": cfg.quorum1,
+        "q2": cfg.quorum2,
         "catchup_rows": cfg.catchup_rows,
         "inbox": cfg.inbox,
         "compact_inbox": cfg.compact_inbox,
@@ -225,15 +230,18 @@ def sweep(protocol: str = "minpaxos", budget_s: float = 900.0,
         protocol, jax.device_count())
     results, dropped = [], []
 
-    def run_point(g, w, p, k, sd, inbox=None, compact=0, derived=None):
+    def run_point(g, w, p, k, sd, inbox=None, compact=0, derived=None,
+                  q1=0, q2=0):
         try:
             rec = measure_point(protocol, g, w, p, k,
                                 dispatches=dispatches, shard_devices=sd,
-                                seed=seed, inbox=inbox, compact=compact)
+                                seed=seed, inbox=inbox, compact=compact,
+                                q1=q1, q2=q2)
         except Exception as e:  # noqa: BLE001 — a too-big point must
             # not kill the sweep; the failure is recorded, not hidden
             rec = {"protocol": protocol, "g": g, "w": w, "p": p, "k": k,
-                   "shard_devices": sd, "error": repr(e)[:200]}
+                   "shard_devices": sd, "q1": q1, "q2": q2,
+                   "error": repr(e)[:200]}
         if derived is not None:
             rec["derived_from_hwm"] = derived
         results.append(rec)
@@ -266,8 +274,48 @@ def sweep(protocol: str = "minpaxos", budget_s: float = 900.0,
                     rec["lossless_vs_base"] = True
         elif base_legal:
             dropped.append(["adaptive", "budget"])
+
+    # flexible-quorum sweep (PR 16): re-measure the crowned SHAPE at
+    # every other certified (q1, q2) pair for n=5 (the ledger rows in
+    # analysis/quorum_golden.GOLDEN_THRESHOLDS — each satisfies
+    # q1 + q2 > n, verify/quorum.py). Smaller q2 means fewer ACCEPT
+    # votes per commit scan; q1 grows to compensate. Every pair bakes
+    # new kernel thresholds (a fresh compile), so the stage is
+    # budget-guarded and only runs on the already-measured winner.
+    legal = [r for r in results if _legal(r)]
+    shape_winner = (max(legal, key=lambda r: r["inst_per_sec"])
+                    if legal else None)
+    quorum_results: list[dict] = []
+    if shape_winner is not None:
+        from minpaxos_tpu.analysis.quorum_golden import GOLDEN_THRESHOLDS
+
+        n = 5  # point_config pins n_replicas=5
+        default_pair = (n // 2 + 1, n // 2 + 1)
+        sw = shape_winner
+        for pair in GOLDEN_THRESHOLDS[n]:
+            if pair == default_pair:
+                continue  # the base grid already measured majority
+            if time.perf_counter() - t_start > budget_s:
+                dropped.append(["quorum", list(pair)])
+                continue
+            rec = run_point(
+                sw["g"], sw["w"], sw["p"], sw["k"], sw["shard_devices"],
+                inbox=sw["inbox"] if sw.get("adaptive") else None,
+                compact=sw.get("compact_inbox", 0),
+                q1=pair[0], q2=pair[1])
+            # same workload schedule as the winner's run: equal
+            # committed totals mean the pair dropped nothing
+            if rec.get("committed_total") == sw.get("committed_total"):
+                rec["lossless_vs_base"] = True
+            quorum_results.append(rec)
     legal = [r for r in results if _legal(r)]
     winner = max(legal, key=lambda r: r["inst_per_sec"]) if legal else None
+    # best point across the default-quorum shape winner and every
+    # legal flexible pair — the artifact's quorum-sweep verdict
+    q_pool = ([shape_winner] if shape_winner is not None else []) + [
+        r for r in quorum_results if _legal(r)]
+    quorum_winner = (max(q_pool, key=lambda r: r["inst_per_sec"])
+                     if q_pool else None)
     return {
         "protocol": protocol,
         "backend": jax.devices()[0].platform,
@@ -276,6 +324,8 @@ def sweep(protocol: str = "minpaxos", budget_s: float = 900.0,
         "points": results,
         "dropped_for_budget": dropped,
         "winner": winner,
+        "quorum_sweep": quorum_results,
+        "quorum_winner": quorum_winner,
     }
 
 

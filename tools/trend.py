@@ -108,6 +108,9 @@ def _row_from_record(name: str, rec: dict, provenance: str,
         "provenance": provenance,
         "platform": rec.get("platform"),
         "resident": rec.get("resident", False),
+        # flexible quorums (PR 16): absent on pre-PR-16 artifacts
+        "q1": rec.get("q1"),
+        "q2": rec.get("q2"),
         "inst_per_sec": value,
         "p50_ms": rec.get("p50_quorum_decision_ms",
                           rec.get("p50_quorum_decision_ms_censored")),
@@ -167,6 +170,10 @@ def collect_tcp_row(repo: Path = REPO) -> dict | None:
         "stage_tail": _stage_tail(rec.get("serial_traced")),
         "stage_tail_baseline": _stage_tail(
             (rec.get("serial_cadence_baseline") or {}).get("serial_traced")),
+        # flexible-quorum paired A/B (PR 16): commit-stage p99 at N=5,
+        # majority (q2=3) vs flexible (q1=4, q2=2)
+        "flex_commit_p99_ms": (
+            rec.get("flex_quorum_ab") or {}).get("commit_p99_ms"),
         "mtime_utc": time.strftime(
             "%Y-%m-%d", time.gmtime(os.path.getmtime(path))),
     }
@@ -295,13 +302,16 @@ def render_markdown(bench, tcp, progress, health=None) -> str:
     for r in bench:
         note = r.get("error") or (
             "replay" if r.get("provenance") == "replay" else "")
+        shape = r.get("shape", "-")
+        if r.get("q1") and r.get("q2"):
+            shape = f"{shape} q={r['q1']}/{r['q2']}"
         out.append(
             f"| {r['artifact']} | {r.get('mtime_utc', '-')} "
             f"| {r.get('platform', '-')} "
             f"| {'y' if r.get('resident') else 'n'} "
             f"| {_fmt(r.get('inst_per_sec'))} | {_fmt(r.get('p50_ms'), 2)} "
             f"| {_fmt(r.get('p99_ms'), 2)} | {_fmt(r.get('concurrent'))} "
-            f"| {r.get('shape', '-')} | {note} |")
+            f"| {shape} | {note} |")
     if tcp:
         out += ["", "## TCP runtime (BENCH_TCP.json)", "",
                 "| artifact | when | ops/s | serial p50 ms | serial p99 ms |",
@@ -327,6 +337,14 @@ def render_markdown(bench, tcp, progress, health=None) -> str:
                     f"| {_fmt(st['total_p99_ms'], 2)} "
                     f"| {f'{share:.0%}' if share is not None else '-'} "
                     f"| {st.get('worst_stage') or '-'} |")
+        flex = tcp.get("flex_commit_p99_ms")
+        if flex:
+            out += ["", "### Flexible-quorum A/B (serial N=5, commit "
+                    "stage p99 ms)", "",
+                    "| majority (q2=3) | flexible (q1=4, q2=2) |",
+                    "|" + "---|" * 2,
+                    f"| {_fmt(flex.get('majority_q2_3'), 2)} "
+                    f"| {_fmt(flex.get('flex_q1_4_q2_2'), 2)} |"]
     if health:
         out += ["", "## Cluster health (paxwatch artifacts)", "",
                 "| artifact | run | ok | alarms | stall live | faults "
