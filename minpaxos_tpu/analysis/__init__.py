@@ -24,7 +24,11 @@ and tier-1 tests only catch by luck:
   ``quorum-certificate`` pass holds every threshold expression to the
   certified ledger ``quorum_golden.py`` (``verify/quorum.py`` proofs,
   re-derived every run), and the paxmc model checker (VERIFY.md)
-  demonstrates the split-brain a forbidden threshold causes.
+  demonstrates the split-brain a forbidden threshold causes. The
+  ``spec-sync`` pass keeps the kernels' MsgKind-handling branches in
+  lock-step with the abstract spec's declared action table
+  (verify/spec.py MSGKIND_ACTIONS) so the refinement harness
+  classifies every edge class the kernels can produce.
 
 ``tools/lint.py`` runs every registered pass over the tree and exits
 nonzero on violations; ``tools/run_tier1.sh`` runs it before pytest so
@@ -52,6 +56,7 @@ from minpaxos_tpu.analysis import (  # noqa: E402,F401  (registration)
     quorum_certificate,
     recompile_hazard,
     resident_loop,
+    spec_sync,
     trace_hazard,
     wall_honesty,
     wire_contract,

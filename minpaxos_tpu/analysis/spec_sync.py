@@ -1,0 +1,145 @@
+"""spec-sync: every kernel MsgKind branch must map to the abstract spec.
+
+The refinement layer (verify/refine.py) holds every explored edge of
+the model checker to the executable abstract Multi-Paxos spec
+(verify/spec.py). That check is only as strong as the declared
+correspondence between kernel message handling and abstract actions:
+``MSGKIND_ACTIONS`` in verify/spec.py. A new ``MsgKind`` handled in a
+kernel with no declared mapping is a consensus transition the
+refinement harness has never classified — it would sail through
+bounded exploration as an unlabeled edge class nobody reasoned about.
+
+This pass keeps the table and the kernels in lock-step, statically:
+
+* a **kernel MsgKind-handling branch** — any comparison mentioning
+  ``MsgKind.X`` (``kind == int(MsgKind.ACCEPT)`` and friends) inside a
+  kernel step module — must name a kind declared in
+  ``MSGKIND_ACTIONS``;
+* a **table entry** must be live (some kernel branch handles it — a
+  stale entry means the table describes a protocol the kernels no
+  longer implement) and must name only ``ABSTRACT_ACTIONS`` members;
+* the **table itself** must stay a plain literal dict of tuples of
+  strings (this pass, like the wire-golden flow, reads it straight
+  out of the AST — no JAX import, per the paxlint cold-start rule).
+
+Host-side runtime modules (models/cluster.py) are out of scope: their
+MsgKind comparisons route client replies, which are environment
+outputs, not consensus transitions with an abstract counterpart.
+
+Failure mode this prevents: ROADMAP item 4 adds reconfiguration —
+a new ``RECONF`` message kind lands in the kernels, commits epoch
+changes, and the refinement harness silently never checks those edges
+because nobody told the spec the action exists.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "spec-sync"
+
+#: where the correspondence table lives
+SPEC_PATH = "minpaxos_tpu/verify/spec.py"
+#: kernel step modules whose MsgKind branches are consensus handling
+SCOPE_PREFIX = "minpaxos_tpu/models/"
+#: host-side runtime files: MsgKind compares there route client
+#: replies, not consensus messages
+HOST_SIDE = ("minpaxos_tpu/models/cluster.py",)
+
+
+def _literal_assign(tree: ast.Module, name: str):
+    """(value-literal, assignment node) for a module-level ``name = …``
+    assignment, or (None, None). Raises ValueError if the value is not
+    a pure literal."""
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            return ast.literal_eval(node.value), node
+    return None, None
+
+
+def _msgkind_compares(tree: ast.Module):
+    """Yield (kind_name, line) for every comparison that mentions
+    ``MsgKind.X`` — the kernels' branch predicates are jnp.where masks
+    built from exactly these compares."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "MsgKind"):
+                yield sub.attr, node.lineno
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    spec = project.get(SPEC_PATH)
+    kernels = [f for f in project.glob(SCOPE_PREFIX)
+               if f.tree is not None and f.path not in HOST_SIDE]
+    # fixture projects without kernels or the spec have nothing to sync
+    if spec is None or spec.tree is None or not kernels:
+        return out
+
+    try:
+        table, table_node = _literal_assign(spec.tree, "MSGKIND_ACTIONS")
+        actions, _ = _literal_assign(spec.tree, "ABSTRACT_ACTIONS")
+    except ValueError:
+        return [Violation(
+            spec.path, 1, RULE,
+            "MSGKIND_ACTIONS / ABSTRACT_ACTIONS must stay pure "
+            "literals (this pass and the refinement harness read them "
+            "from the AST)")]
+    if table is None or table_node is None:
+        return [Violation(
+            spec.path, 1, RULE,
+            "no module-level MSGKIND_ACTIONS literal: the kernel <-> "
+            "abstract-action correspondence table is gone")]
+    vocabulary = set(actions or ())
+
+    # table entries must name only known abstract actions, and the
+    # key line numbers let violations point at the exact entry
+    key_lines = {}
+    if isinstance(table_node.value, ast.Dict):
+        for k in table_node.value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                key_lines[k.value] = k.lineno
+    for kind, mapped in sorted(table.items()):
+        for action in mapped:
+            if action not in vocabulary:
+                out.append(Violation(
+                    spec.path, key_lines.get(kind, table_node.lineno),
+                    RULE,
+                    f"MSGKIND_ACTIONS[{kind!r}] names unknown abstract "
+                    f"action {action!r} (ABSTRACT_ACTIONS = "
+                    f"{sorted(vocabulary)})"))
+
+    # every kernel branch must be declared; report each kind once per
+    # file at its first branch
+    handled: set[str] = set()
+    for f in kernels:
+        seen_here: set[str] = set()
+        for kind, line in _msgkind_compares(f.tree):
+            handled.add(kind)
+            if kind in table or kind in seen_here:
+                continue
+            seen_here.add(kind)
+            out.append(Violation(
+                f.path, line, RULE,
+                f"kernel handles MsgKind.{kind} with no declared "
+                f"abstract-action mapping — add it to MSGKIND_ACTIONS "
+                f"in verify/spec.py (or the refinement harness will "
+                f"never classify these edges)"))
+
+    # declared-but-dead entries: the table must describe THIS kernel
+    for kind in sorted(set(table) - handled):
+        out.append(Violation(
+            spec.path, key_lines.get(kind, table_node.lineno), RULE,
+            f"MSGKIND_ACTIONS declares {kind!r} but no kernel branch "
+            f"handles it — stale mapping (retire it or the table "
+            f"drifts from the implementation)"))
+    return out

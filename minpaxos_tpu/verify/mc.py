@@ -183,6 +183,16 @@ class Counterexample:
     q1: int = 0
     q2: int = 0
     n_replicas: int = 3
+    # paxref extensions (ISSUE 17), all optional in the format:
+    # kind "invariant" (the original safety CEs) | "refinement"
+    # (verify/refine.py — a concrete step with no abstract
+    # counterpart) | "lasso" (verify/liveness.py — trace[loop_start:]
+    # is a fair non-progress cycle). `mutant` names a planted kernel
+    # mutation replay must re-install ("skip-quorum2",
+    # "dueling-leaders").
+    kind: str = "invariant"
+    mutant: str | None = None
+    loop_start: int | None = None
 
     def to_dict(self) -> dict:
         return {"format": CE_FORMAT, "protocol": self.protocol,
@@ -191,19 +201,25 @@ class Counterexample:
                 "q1": self.q1, "q2": self.q2,
                 "n_replicas": self.n_replicas,
                 "trace": self.trace, "report": self.report,
-                "states_explored": self.states_explored}
+                "states_explored": self.states_explored,
+                "kind": self.kind, "mutant": self.mutant,
+                "loop_start": self.loop_start}
 
     @classmethod
     def from_dict(cls, d: dict) -> "Counterexample":
         if d.get("format") != CE_FORMAT:
             raise ValueError(f"not a {CE_FORMAT} counterexample: "
                              f"format={d.get('format')!r}")
+        loop = d.get("loop_start")
         return cls(protocol=d["protocol"], bounds=Bounds(**d["bounds"]),
                    majority_override=d.get("majority_override"),
                    q1=int(d.get("q1", 0)), q2=int(d.get("q2", 0)),
                    n_replicas=int(d.get("n_replicas", 3)),
                    trace=list(d["trace"]), report=dict(d["report"]),
-                   states_explored=int(d.get("states_explored", 0)))
+                   states_explored=int(d.get("states_explored", 0)),
+                   kind=str(d.get("kind", "invariant")),
+                   mutant=d.get("mutant"),
+                   loop_start=None if loop is None else int(loop))
 
 
 @dataclass
@@ -244,6 +260,10 @@ class McResult:
 
 class Explorer:
     """One bounded exhaustive exploration of one protocol."""
+
+    #: True in explorers whose check_edge is not a no-op — run() then
+    #: pays the edge check even on seen-state-pruned transitions
+    _edge_checked = False
 
     def __init__(self, protocol: str, bounds: Bounds | None = None,
                  majority_override: int | None = None, q1: int = 0,
@@ -472,6 +492,26 @@ class Explorer:
             return action["r"]
         return None  # drop / elect never advance a frontier
 
+    # ------------------------------------------------------ paxref hooks
+
+    def check_edge(self, pre_node: tuple, action: dict, post_node: tuple,
+                   report: invariants.CheckReport) -> None:
+        """Per-edge hook: called for EVERY explored transition (run and
+        replay) with the pre/post cluster states. The base explorer
+        checks nothing here; ``verify/refine.py``'s RefinementExplorer
+        overrides it to hold each concrete step to the abstract spec
+        (violations appended to ``report`` fail the edge exactly like
+        an invariant breach)."""
+
+    def _make_ce(self, trace: list[dict], report: dict,
+                 states_explored: int) -> Counterexample:
+        """Counterexample factory — subclasses stamp their kind/mutant
+        so replay can rebuild the same explorer."""
+        return Counterexample(
+            self.protocol, self.bounds, self.majority_override, trace,
+            report, states_explored=states_explored, q1=self.q1,
+            q2=self.q2, n_replicas=self.R)
+
     # ------------------------------------------------------ exploration
 
     def run(self, log=None) -> McResult:
@@ -483,10 +523,7 @@ class Explorer:
         root = self.initial()
         report = self.check_invariants(root[0])
         if not report.ok:  # a broken initial state: depth-0 violation
-            res.counterexample = Counterexample(
-                self.protocol, b, self.majority_override, [],
-                report.to_dict(), q1=self.q1, q2=self.q2,
-                n_replicas=self.R)
+            res.counterexample = self._make_ce([], report.to_dict(), 1)
             res.wall_s = time.monotonic() - t0
             return res
         seen = {self._key(root)}
@@ -511,10 +548,31 @@ class Explorer:
                 nxt = self._apply(node, action)
                 key = self._key(nxt)
                 if key in seen:
+                    # the STATE was certified when first reached, but a
+                    # refinement explorer must still check this EDGE —
+                    # a step into a good state can itself be an
+                    # unmapped abstract transition
+                    if self._edge_checked:
+                        report = invariants.CheckReport()
+                        self.check_edge(node, action, nxt, report)
+                        if not report.ok:
+                            trace = [action]
+                            p = pid
+                            while p >= 0:
+                                par, act = parents[p]
+                                if act is not None:
+                                    trace.append(act)
+                                p = par
+                            trace.reverse()
+                            res.counterexample = self._make_ce(
+                                trace, report.to_dict(), res.states)
+                            res.wall_s = time.monotonic() - t0
+                            return res
                     continue
                 seen.add(key)
                 res.states += 1
                 report = self.check_invariants(nxt[0], stepped, pre)
+                self.check_edge(node, action, nxt, report)
                 if not report.ok:
                     trace = [action]
                     p = pid
@@ -524,10 +582,8 @@ class Explorer:
                             trace.append(act)
                         p = par
                     trace.reverse()
-                    res.counterexample = Counterexample(
-                        self.protocol, b, self.majority_override, trace,
-                        report.to_dict(), states_explored=res.states,
-                        q1=self.q1, q2=self.q2, n_replicas=self.R)
+                    res.counterexample = self._make_ce(
+                        trace, report.to_dict(), res.states)
                     res.wall_s = time.monotonic() - t0
                     return res
                 if res.states >= b.max_states:
@@ -561,8 +617,11 @@ def replay_counterexample(ce: Counterexample | dict,
     """
     if isinstance(ce, dict):
         ce = Counterexample.from_dict(ce)
-    ex = Explorer(ce.protocol, ce.bounds, ce.majority_override,
-                  q1=ce.q1, q2=ce.q2, n_replicas=ce.n_replicas)
+    if ce.kind == "lasso":
+        from minpaxos_tpu.verify.liveness import replay_lasso
+
+        return replay_lasso(ce)
+    ex = _explorer_for(ce)
     node = ex.initial()
     report = ex.check_invariants(node[0])
     if not report.ok:
@@ -571,11 +630,28 @@ def replay_counterexample(ce: Counterexample | dict,
         stepped = Explorer._stepped_replica(action)
         pre = (int(node[0][stepped].committed_upto)
                if stepped is not None else None)
+        prev = node
         node = ex._apply(node, action)
         report = ex.check_invariants(node[0], stepped, pre)
+        ex.check_edge(prev, action, node, report)
         if not report.ok:
             return True, report
     return False, report
+
+
+def _explorer_for(ce: Counterexample) -> Explorer:
+    """Rebuild the explorer a counterexample was found by — the plain
+    safety explorer for kind="invariant" fixtures, the refinement
+    explorer (with its planted mutant re-installed) for
+    kind="refinement" ones."""
+    if ce.kind == "refinement":
+        from minpaxos_tpu.verify.refine import RefinementExplorer
+
+        return RefinementExplorer(
+            ce.protocol, ce.bounds, ce.majority_override, q1=ce.q1,
+            q2=ce.q2, n_replicas=ce.n_replicas, mutant=ce.mutant)
+    return Explorer(ce.protocol, ce.bounds, ce.majority_override,
+                    q1=ce.q1, q2=ce.q2, n_replicas=ce.n_replicas)
 
 
 def counterexample_faultplan(ce: Counterexample | dict,

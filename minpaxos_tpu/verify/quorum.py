@@ -185,6 +185,40 @@ def majority(n: int) -> int:
     return n // 2 + 1
 
 
+def certified_pairs(n: int) -> tuple[tuple[int, int], ...]:
+    """The certified ``(q1, q2)`` threshold pairs for ``n`` replicas,
+    straight from the append-only ledger
+    (``analysis/quorum_golden.GOLDEN_THRESHOLDS``). Imported lazily:
+    the analysis package's pass modules import THIS module at
+    registration time, and the ledger itself is pure data."""
+    from minpaxos_tpu.analysis.quorum_golden import GOLDEN_THRESHOLDS
+
+    return tuple(GOLDEN_THRESHOLDS.get(n, ()))
+
+
+def spec_quorums(n: int, q1: int = 0, q2: int = 0) -> tuple[int, int]:
+    """Resolve a model configuration's quorum pair for the abstract
+    spec (verify/spec.py): 0-sentinels become the majority default
+    exactly as ``MinPaxosConfig.quorum1/quorum2`` resolve them, and
+    the resulting pair MUST be in the certified ledger — re-proved
+    here, not just looked up. This is the spec's ONLY quorum
+    parameter source, so the abstract machine and the compiled
+    kernels can never disagree about which (q1, q2) are legal."""
+    rq1 = q1 if q1 > 0 else majority(n)
+    rq2 = q2 if q2 > 0 else majority(n)
+    if (rq1, rq2) not in certified_pairs(n):
+        raise ValueError(
+            f"(q1={rq1}, q2={rq2}) at n={n} is not in the certified "
+            f"ledger (analysis/quorum_golden.py); certify it first "
+            f"via tools/mc.py --certify {n},{rq1},{rq2}")
+    cert = certify_threshold(n, rq1, rq2)
+    if not (cert.intersects and verify_certificate(cert)):
+        raise ValueError(
+            f"ledger pair (q1={rq1}, q2={rq2}) at n={n} fails "
+            f"re-certification: {cert.reason}")
+    return rq1, rq2
+
+
 def certify_fast(n: int, q1: int, qf: int) -> Certificate:
     """Fast Flexible Paxos fast-quorum certificate (PAPERS.md
     2008.02671): a fast quorum Qf is safe iff any two fast quorums

@@ -1060,6 +1060,129 @@ def test_resident_loop_real_suppression_is_load_bearing():
                       and "scalar readback" in v.msg for v in vs), vs
 
 
+# ----------------------------------------------------------- spec-sync
+
+
+SPEC_MINI = '''
+ABSTRACT_ACTIONS = ("Phase1a", "Phase1b", "Phase2a", "Phase2b",
+                    "Commit", "Skip", "Stutter")
+MSGKIND_ACTIONS = {
+    "PREPARE": ("Phase1a", "Phase1b"),
+    "ACCEPT": ("Phase2a", "Phase2b"),
+}
+'''
+
+SPEC_KERNEL = '''
+def step(kind, MsgKind):
+    p = kind == int(MsgKind.PREPARE)
+    a = kind == int(MsgKind.ACCEPT)
+    return p, a
+'''
+
+SPEC_SYNC_BAD = '''
+from minpaxos_tpu.wire.messages import MsgKind
+
+def step(kind):
+    return kind == int(MsgKind.RECONF)
+'''
+
+
+def lint_spec_pair(kernel_src, spec_src=SPEC_MINI):
+    return run_passes(Project({
+        "minpaxos_tpu/verify/spec.py": spec_src,
+        "minpaxos_tpu/models/kernel.py": kernel_src,
+    }), ("spec-sync",))
+
+
+def test_spec_sync_quiet_when_table_matches_kernel():
+    assert lint_spec_pair(SPEC_KERNEL) == []
+
+
+def test_spec_sync_flags_unmapped_kernel_kind():
+    src = SPEC_KERNEL.replace(
+        "return p, a",
+        "r = kind == int(MsgKind.RECONF)\n"
+        "    r2 = kind == int(MsgKind.RECONF)  # same kind: one report\n"
+        "    return p, a, r, r2")
+    vs = lint_spec_pair(src)
+    assert len(vs) == 1 and vs[0].rule == "spec-sync", vs
+    assert vs[0].path.endswith("kernel.py")
+    assert "MsgKind.RECONF" in vs[0].msg and "MSGKIND_ACTIONS" in vs[0].msg
+
+
+def test_spec_sync_flags_stale_table_entry():
+    vs = lint_spec_pair("def step(kind, MsgKind):\n"
+                        "    return kind == int(MsgKind.PREPARE)\n")
+    assert len(vs) == 1 and "stale" in vs[0].msg, vs
+    assert "'ACCEPT'" in vs[0].msg and vs[0].path.endswith("spec.py")
+
+
+def test_spec_sync_flags_unknown_abstract_action():
+    spec = SPEC_MINI.replace('"ACCEPT": ("Phase2a", "Phase2b"),',
+                             '"ACCEPT": ("Teleport",),')
+    vs = lint_spec_pair(SPEC_KERNEL, spec)
+    assert len(vs) == 1 and "Teleport" in vs[0].msg, vs
+    assert vs[0].path.endswith("spec.py")
+
+
+def test_spec_sync_table_must_stay_pure_literal():
+    spec = ('ABSTRACT_ACTIONS = ("Phase1a",)\n'
+            'MSGKIND_ACTIONS = dict(PREPARE=("Phase1a",))\n')
+    vs = lint_spec_pair("def step(kind, MsgKind):\n"
+                        "    return kind == int(MsgKind.PREPARE)\n", spec)
+    assert len(vs) == 1 and "pure" in vs[0].msg and "literal" in vs[0].msg
+
+
+def test_spec_sync_missing_table_is_a_violation():
+    vs = lint_spec_pair(SPEC_KERNEL, 'ABSTRACT_ACTIONS = ("Phase1a",)\n')
+    assert len(vs) == 1 and "MSGKIND_ACTIONS" in vs[0].msg, vs
+
+
+def test_spec_sync_host_side_cluster_exempt():
+    """models/cluster.py routes client replies (environment outputs,
+    not consensus transitions) — its MsgKind compares are out of
+    scope by design."""
+    vs = run_passes(Project({
+        "minpaxos_tpu/verify/spec.py": SPEC_MINI,
+        "minpaxos_tpu/models/kernel.py": SPEC_KERNEL,
+        "minpaxos_tpu/models/cluster.py":
+            "def route(kind, MsgKind):\n"
+            "    return kind == int(MsgKind.PROPOSE_REPLY)\n",
+    }), ("spec-sync",))
+    assert vs == []
+
+
+def test_spec_sync_silent_without_both_sides():
+    """Fixture projects that carry only kernels or only the spec have
+    nothing to sync (keeps every OTHER rule's fixtures quiet)."""
+    assert run_passes(Project(
+        {"minpaxos_tpu/models/kernel.py": SPEC_SYNC_BAD},
+        ), ("spec-sync",)) == []
+    assert run_passes(Project(
+        {"minpaxos_tpu/verify/spec.py": SPEC_MINI},
+        ), ("spec-sync",)) == []
+
+
+def test_spec_sync_real_table_is_load_bearing():
+    """The real tree is clean, and deleting one real table entry fires
+    exactly the unmapped-kind violation for that kind — the pass is
+    reading the actual correspondence, not rubber-stamping."""
+    files = {p: (REPO / p).read_text() for p in (
+        "minpaxos_tpu/verify/spec.py",
+        "minpaxos_tpu/models/minpaxos.py",
+        "minpaxos_tpu/models/mencius.py",
+        "minpaxos_tpu/models/cluster.py",
+    )}
+    assert run_passes(Project(files), ("spec-sync",)) == []
+    files["minpaxos_tpu/verify/spec.py"] = files[
+        "minpaxos_tpu/verify/spec.py"].replace('    "SKIP": ("Skip",),\n',
+                                               "")
+    vs = run_passes(Project(files), ("spec-sync",))
+    assert vs and all(v.rule == "spec-sync" for v in vs), vs
+    assert any("MsgKind.SKIP" in v.msg
+               and v.path.endswith("mencius.py") for v in vs), vs
+
+
 _CLI_SEEDS = {
     "trace-hazard": ("minpaxos_tpu/models/seed.py", TRACE_BAD),
     "recompile-hazard": ("minpaxos_tpu/ops/seed.py",
@@ -1075,6 +1198,7 @@ _CLI_SEEDS = {
     "quorum-certificate": ("minpaxos_tpu/models/flex.py", QUORUM_BAD),
     "lock-order": ("minpaxos_tpu/runtime/transport.py", LOCK_CYCLE),
     "resident-loop": ("minpaxos_tpu/parallel/seed.py", RESIDENT_BAD),
+    "spec-sync": ("minpaxos_tpu/models/seed.py", SPEC_SYNC_BAD),
 }
 
 
@@ -1088,6 +1212,11 @@ def test_cli_nonzero_on_each_seeded_rule(tmp_path, rule):
     dst = tmp_path / rel
     dst.parent.mkdir(parents=True, exist_ok=True)
     dst.write_text(src)
+    if rule == "spec-sync":  # needs the real table alongside the seed
+        spec_rel = "minpaxos_tpu/verify/spec.py"
+        spec_dst = tmp_path / spec_rel
+        spec_dst.parent.mkdir(parents=True, exist_ok=True)
+        spec_dst.write_text((REPO / spec_rel).read_text())
     out = subprocess.run(
         [sys.executable, str(REPO / "tools/lint.py"), "--root",
          str(tmp_path), "--rules", rule, "--json"],

@@ -65,7 +65,8 @@ def test_mc_counterexample_fixture_replays(path):
     # (exact strings may drift with numpy reprs; the invariant may not)
     recorded = " ".join(ce["report"]["violations"])
     replayed = " ".join(report.violations)
-    for marker in ("DIVERGENCE", "BACKWARD", "never proposed"):
+    for marker in ("DIVERGENCE", "BACKWARD", "never proposed",
+                   "REFINEMENT", "LASSO"):
         if marker in recorded:
             assert marker in replayed, (marker, report.violations)
 

@@ -10,6 +10,14 @@
                                         # exit 0 iff the split-brain
                                         # counterexample IS found
     tools/mc.py --replay tests/fixtures/mc_broken_quorum_minpaxos.json
+    tools/mc.py --refine                # map every explored edge onto
+                                        # the abstract spec (paxref)
+    tools/mc.py --liveness              # eventual commit under weak
+                                        # fairness (lasso/SCC search)
+    tools/mc.py --refine --spec-pair 1,3
+    tools/mc.py --mutant skip-quorum2   # commit below q2: refinement
+                                        # CE found iff exit 0
+    tools/mc.py --mutant dueling-leaders  # livelock: fair lasso
     tools/mc.py --emit-faultplan ce.json > plan.json
     tools/mc.py --certify 5,4,2         # quorum certificate + ledger line
     tools/mc.py --print-quorum-golden   # re-verified certified ledger
@@ -106,14 +114,166 @@ def _flex_mutant_bounds():
                   electable=(1,), n_cmds=2, propose_to=(0, 1))
 
 
+#: the default flexible (q1, q2) pair for refinement/liveness legs —
+#: the same certified extreme point the flex smoke leg drives
+SPEC_PAIR = (3, 1)
+
+
+def _refine_legs(pair=SPEC_PAIR):
+    from minpaxos_tpu.verify.mc import Bounds
+
+    # paxref refinement legs (ISSUE 17): every explored edge of each
+    # run is mapped onto the abstract spec (verify/refine.py) — sized
+    # so classic/mencius/flex reach Commit-labeled edges while the
+    # whole block stays a small slice of the smoke budget (minpaxos
+    # commits need depth 5; its depth-4 leg still certifies the
+    # Phase1/Phase2 edge classes plus the election interleavings)
+    minpaxos = Bounds(max_depth=4, drops=1, dups=0, internal=1,
+                      elections=1, n_cmds=1, propose_to=(0,))
+    classic = Bounds(max_depth=5, drops=1, dups=0, internal=1,
+                     elections=0, n_cmds=1, propose_to=(0,))
+    mencius = Bounds(max_depth=4, drops=1, dups=0, internal=1,
+                     elections=0, n_cmds=1, propose_to=(0, 1))
+    flex = Bounds(max_depth=4, drops=0, dups=0, internal=1,
+                  elections=0, n_cmds=1, propose_to=(0,))
+    q1, q2 = pair
+    return [("refine-minpaxos", "minpaxos", minpaxos, {}),
+            ("refine-classic", "classic", classic, {}),
+            ("refine-mencius", "mencius", mencius, {}),
+            (f"refine-minpaxos-flex-q1={q1}-q2={q2}", "minpaxos", flex,
+             {"q1": q1, "q2": q2})]
+
+
+def _run_refine(pair=SPEC_PAIR, log=print):
+    """Run the refinement legs; every edge of every leg must map onto
+    an abstract spec action (or a stutter) with zero violations."""
+    from minpaxos_tpu.verify.refine import RefinementExplorer
+
+    legs, ok = [], True
+    for label, proto, b, kw in _refine_legs(pair):
+        log(f"[paxmc] {label} (depth {b.max_depth}) ...", flush=True)
+        ex = RefinementExplorer(proto, b, **kw)
+        res = ex.run()
+        stats = ex.refine_stats()
+        ok = ok and res.ok and res.drained
+        legs.append({
+            "label": label, "ok": res.ok, "drained": res.drained,
+            "states": res.states, "wall_s": round(res.wall_s, 2),
+            "spec_q1": stats["spec_q1"], "spec_q2": stats["spec_q2"],
+            "edges_checked": stats["edges_checked"],
+            "abstract_actions": stats["abstract_actions"],
+            "counterexample": (None if res.counterexample is None
+                               else res.counterexample.to_dict())})
+        log(f"[paxmc]   -> {'ok' if res.ok else 'VIOLATION'} "
+            f"edges={stats['edges_checked']} "
+            f"actions={stats['abstract_actions']} "
+            f"wall={res.wall_s:.1f}s", flush=True)
+    return {"ok": ok,
+            "edges_checked": sum(l["edges_checked"] for l in legs),
+            "legs": legs}
+
+
+def _skip_quorum2_bounds():
+    from minpaxos_tpu.verify.mc import Bounds
+
+    # the planted early-commit mutant needs no faults at all: the
+    # leader commits its own slot off a single vote three deliveries
+    # in (tests/fixtures/mc_refine_skip_quorum2_minpaxos.json)
+    return Bounds(max_depth=5, drops=0, dups=0, internal=1,
+                  elections=0, n_cmds=1, propose_to=(0,))
+
+
+def _refine_mutant_self_test(log=print):
+    """A refinement checker that cannot catch a leader committing
+    below q2 certifies nothing: plant skip-quorum2 and demand the
+    commit-no-quorum counterexample is found AND replays. The mutant
+    passes every safety invariant (only the leader commits early, so
+    no two replicas disagree) — exactly the bug class refinement
+    exists to catch."""
+    from minpaxos_tpu.verify.mc import replay_counterexample
+    from minpaxos_tpu.verify.refine import RefinementExplorer
+
+    ex = RefinementExplorer("minpaxos", _skip_quorum2_bounds(),
+                            mutant="skip-quorum2")
+    res = ex.run()
+    found = res.counterexample is not None
+    reproduced = found and replay_counterexample(
+        res.counterexample.to_dict())[0]
+    log(f"[paxmc] refine-mutant skip-quorum2: found={found} "
+        f"replayed={reproduced} states={res.states} "
+        f"wall={res.wall_s:.1f}s", flush=True)
+    return {"mutant": "skip-quorum2", "found": found,
+            "replay_reproduced": reproduced, "states": res.states,
+            "wall_s": round(res.wall_s, 1),
+            "trace_len": (len(res.counterexample.trace) if found else 0),
+            "counterexample": (res.counterexample.to_dict()
+                               if found else None)}
+
+
+def _run_liveness(pair=SPEC_PAIR, log=print):
+    """Liveness legs: eventual commit under weak fairness for the
+    default quorums and one certified flexible pair (minpaxos; classic
+    explicit-commit traffic overflows the smoke-sized state cap and
+    mencius liveness is deferred with its reconfiguration story)."""
+    from minpaxos_tpu.verify.liveness import LivenessExplorer, fair_bounds
+
+    q1, q2 = pair
+    legs_spec = [("liveness-minpaxos-default", {}),
+                 (f"liveness-minpaxos-flex-q1={q1}-q2={q2}",
+                  {"q1": q1, "q2": q2})]
+    legs, ok = [], True
+    for label, kw in legs_spec:
+        log(f"[paxmc] {label} ...", flush=True)
+        r = LivenessExplorer("minpaxos", fair_bounds(n_cmds=1),
+                             max_states=10_000, **kw).explore()
+        ok = ok and r.ok
+        legs.append(dict(r.to_dict(), label=label))
+        log(f"[paxmc]   -> {'ok' if r.ok else 'FAIL'} states={r.states} "
+            f"goal={r.goal_states} deadlocks={r.deadlocks} "
+            f"lassos={r.fair_lassos} drained={r.drained} "
+            f"wall={r.wall_s:.1f}s", flush=True)
+    return {"ok": ok, "legs": legs}
+
+
+def _lasso_mutant_self_test(log=print):
+    """The liveness twin of the quorum mutants: plant dueling leaders
+    (unbudgeted mutual preemption on replicas 0 and 1) and demand a
+    fair lasso is found and its stem+cycle replays to the same
+    quotient state with the command uncommitted."""
+    from minpaxos_tpu.verify.liveness import (LivenessExplorer,
+                                              dueling_bounds)
+    from minpaxos_tpu.verify.mc import replay_counterexample
+
+    r = LivenessExplorer("minpaxos", dueling_bounds(),
+                         mutant="dueling-leaders", max_states=3000,
+                         max_queue_rows=10).explore()
+    found = r.fair_lassos > 0 and r.lasso is not None
+    reproduced = found and replay_counterexample(r.lasso.to_dict())[0]
+    log(f"[paxmc] liveness-mutant dueling-leaders: found={found} "
+        f"replayed={reproduced} states={r.states} "
+        f"lassos={r.fair_lassos} wall={r.wall_s:.1f}s", flush=True)
+    return {"mutant": "dueling-leaders", "found": found,
+            "replay_reproduced": reproduced, "states": r.states,
+            "fair_lassos": r.fair_lassos, "wall_s": round(r.wall_s, 1),
+            "trace_len": (len(r.lasso.trace) if found else 0),
+            "loop_start": (r.lasso.loop_start if found else None),
+            "counterexample": (r.lasso.to_dict() if found else None)}
+
+
 def _flex_certified_runs(log=print):
     """One bounded exploration per certified (q1, q2) ledger pair at
     N=3..5 (GOLDEN_THRESHOLDS), minpaxos kernel: BFS must drain with 0
     violations for every pair. Bounds shrink with N (the link count
     grows the branching factor) — each leg still reaches commits for
-    the small-q2 pairs, and every reached state is invariant-checked."""
+    the small-q2 pairs. Since ISSUE 17 each run is a
+    ``RefinementExplorer``: on top of the invariant suite, EVERY
+    explored edge is held to the abstract spec parameterized by that
+    ledger pair (verify/spec.py), so the certified sweep proves the
+    kernels implement flexible Paxos — not merely that they avoid
+    split-brain within these bounds."""
     from minpaxos_tpu.analysis.quorum_golden import GOLDEN_THRESHOLDS
-    from minpaxos_tpu.verify.mc import Bounds, Explorer
+    from minpaxos_tpu.verify.mc import Bounds
+    from minpaxos_tpu.verify.refine import RefinementExplorer
 
     runs = []
     for n in (3, 4, 5):
@@ -124,12 +284,14 @@ def _flex_certified_runs(log=print):
         for q1, q2 in GOLDEN_THRESHOLDS.get(n, ()):
             log(f"[paxmc] flex-certified: n={n} q1={q1} q2={q2} "
                 f"(depth {b.max_depth}) ...")
-            res = Explorer("minpaxos", b, q1=q1, q2=q2,
-                           n_replicas=n).run()
-            runs.append(res)
+            ex = RefinementExplorer("minpaxos", b, q1=q1, q2=q2,
+                                    n_replicas=n)
+            res = ex.run()
+            stats = ex.refine_stats()
+            runs.append((res, stats))
             log(f"[paxmc]   -> {'ok' if res.ok else 'VIOLATION'} "
-                f"states={res.states} drained={res.drained} "
-                f"wall={res.wall_s:.1f}s")
+                f"states={res.states} edges={stats['edges_checked']} "
+                f"drained={res.drained} wall={res.wall_s:.1f}s")
     return runs
 
 
@@ -203,14 +365,21 @@ def main(argv=None) -> int:
     p.add_argument("--dups", type=int, default=None)
     p.add_argument("--reorders", type=int, default=None)
     p.add_argument("--internal", type=int, default=None)
-    p.add_argument("--mutant", choices=["broken-quorum", "flex-broken"],
+    p.add_argument("--mutant", choices=["broken-quorum", "flex-broken",
+                                        "skip-quorum2",
+                                        "dueling-leaders"],
                    default=None,
                    help="seeded mutant: 'broken-quorum' forces the "
                         "threshold to 1 via the property override; "
                         "'flex-broken' plants the non-intersecting "
                         f"flexible pair {FLEX_MUTANT} through the real "
-                        "cfg.q1/cfg.q2 fields. Exit 0 iff the "
-                        "counterexample is found and replays")
+                        "cfg.q1/cfg.q2 fields; 'skip-quorum2' makes "
+                        "the leader commit below the phase-2 quorum "
+                        "(caught only by --refine's spec mapping); "
+                        "'dueling-leaders' un-budgets mutual "
+                        "preemption (caught only by --liveness as a "
+                        "fair lasso). Exit 0 iff the counterexample "
+                        "is found and replays")
     p.add_argument("--q1", type=int, default=0,
                    help="flexible phase-1 quorum (0 = majority)")
     p.add_argument("--q2", type=int, default=0,
@@ -218,8 +387,24 @@ def main(argv=None) -> int:
     p.add_argument("--n", type=int, default=3, help="model replicas")
     p.add_argument("--flex-certified", action="store_true",
                    help="explore every certified GOLDEN_THRESHOLDS "
-                        "(q1,q2) pair at N=3..5 (minpaxos); exit 0 iff "
+                        "(q1,q2) pair at N=3..5 (minpaxos) with "
+                        "per-edge refinement checking; exit 0 iff "
                         "all drain with 0 violations")
+    p.add_argument("--refine", action="store_true",
+                   help="refinement legs: map every explored edge of "
+                        "all 3 protocols (plus the --spec-pair "
+                        "flexible leg) onto the abstract Paxos spec; "
+                        "exit 0 iff every edge has an abstract "
+                        "counterpart")
+    p.add_argument("--liveness", action="store_true",
+                   help="liveness legs: prove eventual commit under "
+                        "weak fairness (lasso/SCC search over the "
+                        "fair-suffix graph) for the default quorums "
+                        "and the --spec-pair flexible pair")
+    p.add_argument("--spec-pair", default=None, metavar="Q1,Q2",
+                   help="certified (q1,q2) pair for the flexible "
+                        f"refinement/liveness legs (default "
+                        f"{SPEC_PAIR[0]},{SPEC_PAIR[1]})")
     p.add_argument("--replay", default=None, metavar="CE_JSON",
                    help="replay a counterexample trace; exit 0 iff the "
                         "violation reproduces")
@@ -279,22 +464,82 @@ def main(argv=None) -> int:
         from dataclasses import replace
         return replace(b, **kw) if kw else b
 
+    try:
+        spec_pair = (SPEC_PAIR if args.spec_pair is None
+                     else tuple(int(x) for x in args.spec_pair.split(",")))
+        if len(spec_pair) != 2:
+            raise ValueError("need exactly Q1,Q2")
+    except ValueError as e:
+        p.error(f"bad --spec-pair {args.spec_pair!r}: {e}")
+
     if args.flex_certified:
         runs = _flex_certified_runs()
-        ok = all(r.ok and r.drained for r in runs)
+        ok = all(r.ok and r.drained for r, _s in runs)
+        # the flexible liveness leg rides along: the certified sweep
+        # says every pair is SAFE; this says the extreme point also
+        # still COMMITS under weak fairness
+        liveness = _run_liveness(spec_pair)
+        ok = ok and liveness["ok"]
         verdict = {"ok": ok, "flex_certified": True,
-                   "runs": [r.to_dict() for r in runs]}
+                   "refined_edges": sum(s["edges_checked"]
+                                        for _r, s in runs),
+                   "runs": [dict(r.to_dict(),
+                                 edges_checked=s["edges_checked"],
+                                 abstract_actions=s["abstract_actions"])
+                            for r, s in runs],
+                   "liveness": liveness}
         print(f"[paxmc] flex-certified verdict: "
-              f"{json.dumps({'ok': ok, 'pairs': len(runs)})}", flush=True)
+              f"{json.dumps({'ok': ok, 'pairs': len(runs), 'refined_edges': verdict['refined_edges']})}",
+              flush=True)
         if args.json:
             Path(args.json).write_text(json.dumps(verdict, indent=1))
         return 0 if ok else 1
+
+    if args.refine or args.liveness:
+        verdict, ok = {}, True
+        if args.refine:
+            rv = _run_refine(spec_pair)
+            verdict["refine"] = rv
+            ok = ok and rv["ok"]
+        if args.liveness:
+            lv = _run_liveness(spec_pair)
+            verdict["liveness"] = lv
+            ok = ok and lv["ok"]
+        verdict["ok"] = ok
+        line = {"ok": ok}
+        if args.refine:
+            line["refined_edges"] = verdict["refine"]["edges_checked"]
+        if args.liveness:
+            line["liveness_legs"] = len(verdict["liveness"]["legs"])
+        print(f"[paxmc] verdict: {json.dumps(line)}", flush=True)
+        if args.json:
+            Path(args.json).write_text(json.dumps(verdict, indent=1))
+        return 0 if ok else 1
+
+    if args.mutant == "dueling-leaders":
+        # liveness mutant: the "counterexample" is a fair lasso, not
+        # an invariant breach — found/replayed via the lasso contract
+        line = _lasso_mutant_self_test(log=print)
+        ce = line.pop("counterexample")
+        if ce is not None and args.emit_trace:
+            Path(args.emit_trace).write_text(json.dumps(ce, indent=1))
+            line["trace"] = args.emit_trace
+        print(f"[paxmc] {json.dumps(line)}", flush=True)
+        if args.json:
+            Path(args.json).write_text(
+                json.dumps(dict(line, counterexample=ce), indent=1))
+        return 0 if line["found"] and line["replay_reproduced"] else 1
 
     if args.mutant:
         proto = "minpaxos" if args.protocol == "all" else args.protocol
         if args.mutant == "flex-broken":
             b = override(_flex_mutant_bounds())
             res = Explorer(proto, b, **FLEX_MUTANT).run(log=print)
+        elif args.mutant == "skip-quorum2":
+            from minpaxos_tpu.verify.refine import RefinementExplorer
+            b = override(_skip_quorum2_bounds())
+            res = RefinementExplorer(proto, b,
+                                     mutant="skip-quorum2").run(log=print)
         else:
             b = override(_mutant_bounds())
             res = Explorer(proto, b, majority_override=1).run(log=print)
@@ -384,6 +629,25 @@ def main(argv=None) -> int:
             "states": fres.states, "wall_s": round(fres.wall_s, 1),
             "trace_len": (len(fres.counterexample.trace) if ffound else 0)}
         ok = ok and ffound and freproduced
+        # paxref legs (ISSUE 17): refinement over all 3 protocols plus
+        # the flexible pair, liveness under weak fairness, and the
+        # planted mutants each layer exists to catch — all riding the
+        # same compiled kernel shapes as the legs above (the per-
+        # instance jit closures hit the persistent compile cache)
+        rv = _run_refine(spec_pair, log=print)
+        verdict["refine"] = rv
+        ok = ok and rv["ok"]
+        rm = _refine_mutant_self_test(log=print)
+        rm.pop("counterexample")
+        verdict["refine_mutant_self_test"] = rm
+        ok = ok and rm["found"] and rm["replay_reproduced"]
+        lv = _run_liveness(spec_pair, log=print)
+        verdict["liveness"] = lv
+        ok = ok and lv["ok"]
+        lm = _lasso_mutant_self_test(log=print)
+        lm.pop("counterexample")
+        verdict["lasso_mutant_self_test"] = lm
+        ok = ok and lm["found"] and lm["replay_reproduced"]
         checked_wall = time.monotonic() - (t_budget or t_start)
         verdict["budget_s"] = SMOKE_BUDGET_S
         verdict["within_budget"] = checked_wall <= SMOKE_BUDGET_S
@@ -409,6 +673,12 @@ def main(argv=None) -> int:
         line["mutant_self_test"] = verdict["mutant_self_test"]["found"]
         line["flex_mutant_self_test"] = (
             verdict["flex_mutant_self_test"]["found"])
+        line["refined_edges"] = verdict["refine"]["edges_checked"]
+        line["refine_mutant_self_test"] = (
+            verdict["refine_mutant_self_test"]["found"])
+        line["liveness_ok"] = verdict["liveness"]["ok"]
+        line["lasso_mutant_self_test"] = (
+            verdict["lasso_mutant_self_test"]["found"])
     print(f"[paxmc] verdict: {json.dumps(line)}", flush=True)
     if args.json:
         Path(args.json).write_text(json.dumps(verdict, indent=1))

@@ -7,7 +7,9 @@ every session that wanted the regression view re-opened BENCH_*.json by
 hand. This tool prints it once: per committed accelerator artifact
 (`BENCH_r*.json` driver captures, `BENCH_LADDER_CPU.json`,
 `BENCH_TCP.json`) the headline throughput, quorum p50/p99, platform and
-shape — plus the repo-growth trajectory from `PROGRESS.jsonl` (per
+shape — plus verification coverage from the model-checker artifacts
+(`MC.json`/`MC_FLEX.json`: refined edges, fair lassos, mutant
+self-tests) and the repo-growth trajectory from `PROGRESS.jsonl` (per
 driver round: commits, LoC). Report-only: reads the committed
 artifacts, writes nothing, imports no JAX — safe to run anywhere,
 cheap enough to paste into a PR description.
@@ -267,6 +269,59 @@ def collect_health_rows(repo: Path = REPO) -> list[dict]:
     return rows
 
 
+def collect_verify_rows(repo: Path = REPO) -> list[dict]:
+    """Verification evidence from the committed model-checker
+    artifacts: per MC.json / MC_FLEX.json run the state/transition
+    totals, paxref refinement coverage (edges held to the abstract
+    spec), liveness verdicts (fair lassos found — 0 on healthy legs),
+    and which seeded mutants the self-tests re-found. Trended so a
+    PR that quietly shrinks coverage (fewer refined edges, a skipped
+    mutant) shows up next to the throughput row it bought."""
+    rows: list[dict] = []
+    for name in ("MC.json", "MC_FLEX.json"):
+        path = repo / name
+        if not path.exists():
+            continue
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"artifact": name, "error": repr(e)[:60]})
+            continue
+        runs = doc.get("runs") or []
+        refine = doc.get("refine") or {}
+        liveness = doc.get("liveness") or {}
+        live_legs = liveness.get("legs") or []
+        mutants = {
+            "quorum": (doc.get("mutant_self_test") or {}).get("found"),
+            "flex": (doc.get("flex_mutant_self_test") or {}).get("found"),
+            "refine": (doc.get("refine_mutant_self_test")
+                       or {}).get("found"),
+            "lasso": (doc.get("lasso_mutant_self_test") or {}).get("found"),
+        }
+        rows.append({
+            "artifact": name,
+            "ok": doc.get("ok"),
+            "runs": len(runs),
+            "states": sum(r.get("states") or 0 for r in runs),
+            "transitions": sum(r.get("transitions") or 0 for r in runs),
+            # MC_FLEX stamps refined_edges at top level (every sweep
+            # run is refinement-checked); MC.json under "refine"
+            "refined_edges": (doc.get("refined_edges")
+                              if doc.get("refined_edges") is not None
+                              else refine.get("edges_checked")),
+            "liveness_legs": len(live_legs),
+            "fair_lassos": sum(l.get("fair_lassos") or 0
+                               for l in live_legs),
+            "mutants_found": " ".join(
+                f"{k}:{'y' if v else 'n'}" for k, v in mutants.items()
+                if v is not None) or None,
+            "wall_s": doc.get("wall_s"),
+            "mtime_utc": time.strftime(
+                "%Y-%m-%d", time.gmtime(os.path.getmtime(path))),
+        })
+    return rows
+
+
 def collect_progress(repo: Path = REPO) -> list[dict]:
     """Last PROGRESS.jsonl sample per driver round: commits and LoC at
     round end — the repo-growth axis the bench trajectory rides on."""
@@ -294,7 +349,7 @@ def _fmt_counts(d: dict | None) -> str:
     return " ".join(f"{k}:{v}" for k, v in sorted(d.items()))
 
 
-def render_markdown(bench, tcp, progress, health=None) -> str:
+def render_markdown(bench, tcp, progress, health=None, verify=None) -> str:
     out = ["## Cross-PR bench trajectory (device loop)", ""]
     hdr = ("| artifact | when | platform | resident | inst/s | p50 ms "
            "| p99 ms | concurrent | shape | note |")
@@ -367,6 +422,25 @@ def render_markdown(bench, tcp, progress, health=None) -> str:
                     f"| {h.get('stall_live') or '-'} "
                     f"| {_fmt(h.get('faults'))} "
                     f"| {_fmt_counts(h.get('events'))} |")
+    if verify:
+        out += ["", "## Verification coverage (paxmc/paxref artifacts)", "",
+                "| artifact | when | ok | runs | states | transitions "
+                "| refined edges | liveness legs | fair lassos "
+                "| mutants re-found | wall s |", "|" + "---|" * 11]
+        for v in verify:
+            if v.get("error"):
+                out.append(f"| {v['artifact']} | - | - | - | - | - | - "
+                           f"| - | - | - | {v['error']} |")
+                continue
+            out.append(
+                f"| {v['artifact']} | {v.get('mtime_utc', '-')} "
+                f"| {'y' if v.get('ok') else 'n'} | {_fmt(v.get('runs'))} "
+                f"| {_fmt(v.get('states'))} | {_fmt(v.get('transitions'))} "
+                f"| {_fmt(v.get('refined_edges'))} "
+                f"| {_fmt(v.get('liveness_legs'))} "
+                f"| {_fmt(v.get('fair_lassos'))} "
+                f"| {v.get('mutants_found') or '-'} "
+                f"| {_fmt(v.get('wall_s'))} |")
     if progress:
         out += ["", "## Repo growth (PROGRESS.jsonl, per driver round)", "",
                 "| round | commits | LoC | wall h |", "|" + "---|" * 4]
@@ -390,12 +464,14 @@ def main(argv=None) -> int:
     tcp = collect_tcp_row(repo)
     progress = collect_progress(repo)
     health = collect_health_rows(repo)
+    verify = collect_verify_rows(repo)
     if args.json:
         print(json.dumps({"bench": bench, "tcp": tcp,
-                          "progress": progress, "health": health},
+                          "progress": progress, "health": health,
+                          "verify": verify},
                          indent=1))
     else:
-        print(render_markdown(bench, tcp, progress, health))
+        print(render_markdown(bench, tcp, progress, health, verify))
     return 0
 
 
