@@ -169,7 +169,8 @@ class ChaosCluster:
 
     def __init__(self, n: int = 3, store_dir: str | None = None,
                  durable: bool = False, tick_s: float = 0.001,
-                 q1: int = 0, q2: int = 0):
+                 q1: int = 0, q2: int = 0,
+                 flags: dict | None = None):
         # late imports: chaos/__init__ must stay importable without JAX
         from minpaxos_tpu.models.minpaxos import MinPaxosConfig
         from minpaxos_tpu.runtime.master import Master, register_with_master
@@ -205,8 +206,12 @@ class ChaosCluster:
             # certify intersection BEFORE the replicas boot: a chaos
             # harness must never drive a split-brain-capable cluster
             validate_config_quorums(self.cfg)
+            # extra RuntimeFlags fields (e.g. paxsoak sizing the
+            # ingress coalescer's row cap to the host's commit rate so
+            # the admission gate engages at realistic queue depths)
             self._mk_flags = lambda: RuntimeFlags(
-                durable=durable, store_dir=store_dir, tick_s=tick_s)
+                durable=durable, store_dir=store_dir, tick_s=tick_s,
+                **(flags or {}))
             for i in range(n):
                 s = ReplicaServer(i, self.addrs, self.cfg,
                                   self._mk_flags())

@@ -65,16 +65,26 @@ SEV_NAMES = ("info", "warn", "alert")
 (EV_NONE, EV_ELECTION, EV_LEADER_CHANGE, EV_CLIENT_FAILOVER,
  EV_CHAOS_INSTALL, EV_CHAOS_CLEAR, EV_STORE_CORRUPT,
  EV_NARROW_FALLBACK, EV_LATENCY_OVERFLOW, EV_PEER_DOWN, EV_PEER_UP,
- EV_FATAL, EV_ALARM, EV_ALARM_CLEAR) = range(14)
+ EV_FATAL, EV_ALARM, EV_ALARM_CLEAR, EV_PHASE) = range(15)
 EVENT_NAMES = ("none", "election", "leader_change", "client_failover",
                "chaos_install", "chaos_clear", "store_corrupt",
                "narrow_fallback", "latency_overflow", "peer_down",
-               "peer_up", "fatal", "alarm", "alarm_clear")
+               "peer_up", "fatal", "alarm", "alarm_clear", "phase")
 
 #: per-event default severities (the recorder may override)
 EVENT_SEVERITY = (SEV_INFO, SEV_INFO, SEV_INFO, SEV_WARN, SEV_WARN,
                   SEV_INFO, SEV_ALERT, SEV_WARN, SEV_WARN, SEV_WARN,
-                  SEV_INFO, SEV_ALERT, SEV_ALERT, SEV_INFO)
+                  SEV_INFO, SEV_ALERT, SEV_ALERT, SEV_INFO, SEV_INFO)
+
+#: soak phase kinds (ride EV_PHASE events in the aux field; the
+#: subject field carries the phase ordinal within the scenario, the
+#: value field the planned duration in ms). Append-only like the kind
+#: table: SOAK.json and paxtop key on these ids.
+(PHASE_NONE, PHASE_WARMUP, PHASE_SKEW, PHASE_OVERLOAD,
+ PHASE_PARTITION, PHASE_HEAL, PHASE_DRAIN, PHASE_CUSTOM) = range(8)
+PHASE_KIND_NAMES = ("none", "warmup", "skew", "overload", "partition",
+                    "heal", "drain", "custom")
+PHASE_KIND_IDS = {n: i for i, n in enumerate(PHASE_KIND_NAMES)}
 
 #: detector ids (ride EV_ALARM/EV_ALARM_CLEAR events in the aux field)
 DET_STALL, DET_CHURN, DET_BACKLOG, DET_BURN = 1, 2, 3, 4

@@ -104,7 +104,8 @@ def threefry2x32_host(k0, k1, c0, c1):
 
 
 def workload_lanes(n_shards: int, ext_rows: int, round_idx, seed,
-                   key_space: int = 1 << 20):
+                   key_space: int = 1 << 20, hot_pct: int = 0,
+                   hot_keys: int = 8):
     """(key, val) int32 lanes for ``round_idx`` — a scalar (one round,
     [G, M]) or a [k] vector (all of a fused dispatch's rounds at once,
     [k, G, M]). The fused runners pass the VECTOR form and hoist this
@@ -117,7 +118,14 @@ def workload_lanes(n_shards: int, ext_rows: int, round_idx, seed,
 
     Values are raw Threefry lane 1; keys walk the bounded power-of-two
     ``key_space`` from a per-(shard, round) lane-0 base with an odd
-    stride — distinct within a round (see module docstring)."""
+    stride — distinct within a round (see module docstring).
+
+    ``hot_pct`` (paxsoak's hot-key-skew knob): that percentage of
+    rows redirect their key into the ``hot_keys`` lowest slots, drawn
+    from an INDEPENDENT Threefry counter block (shard + n_shards) so
+    the redirect decision never correlates with the value lane. The
+    knob is Python-gated: at the default 0 the traced graph and the
+    emitted stream are byte-identical to the pinned golden digests."""
     r = jnp.asarray(round_idx, jnp.int32)[..., None, None]
     b0, b1 = threefry2x32(seed, r,
                           jnp.arange(n_shards, dtype=jnp.int32)[:, None],
@@ -125,6 +133,15 @@ def workload_lanes(n_shards: int, ext_rows: int, round_idx, seed,
     colu = jnp.arange(ext_rows, dtype=jnp.uint32)
     key = ((b0[..., :1] + colu * jnp.uint32(_KEY_STRIDE))
            & jnp.uint32(key_space - 1)).astype(jnp.int32)
+    if hot_pct:
+        h0, h1 = threefry2x32(
+            seed, r,
+            jnp.arange(n_shards, dtype=jnp.int32)[:, None]
+            + jnp.int32(n_shards),
+            jnp.arange(ext_rows, dtype=jnp.int32)[None, :])
+        redirect = (h0 % jnp.uint32(100)) < jnp.uint32(hot_pct)
+        hot = (h1 % jnp.uint32(hot_keys)).astype(jnp.int32)
+        key = jnp.where(redirect, hot, key)
     return key, b1.astype(jnp.int32)
 
 
@@ -161,23 +178,27 @@ def assemble_batch(n_replicas: int, n_shards: int, ext_rows: int,
 
 def propose_batch(n_replicas: int, n_shards: int, ext_rows: int,
                   count, leader, round_idx, seed,
-                  key_space: int = 1 << 20) -> MsgBatch:
+                  key_space: int = 1 << 20, hot_pct: int = 0,
+                  hot_keys: int = 8) -> MsgBatch:
     """[G, R, M] PROPOSE rows for one protocol round, generated on
     device (``workload_lanes`` + ``assemble_batch``). ``key_space``
     must be a power of two and at or below half the KV capacity so
-    long runs don't saturate the table.
+    long runs don't saturate the table. ``hot_pct``/``hot_keys``:
+    the Python-gated hot-key-skew knob (see ``workload_lanes``).
 
     Pure jnp: callers jit it directly (parallel/sharded.py
     ``make_propose_ext``) or trace it inside a fused scan."""
     key, val = workload_lanes(n_shards, ext_rows, round_idx, seed,
-                              key_space)
+                              key_space, hot_pct=hot_pct,
+                              hot_keys=hot_keys)
     return assemble_batch(n_replicas, n_shards, ext_rows, count, leader,
                           round_idx, key, val)
 
 
 def propose_batch_host(n_replicas: int, n_shards: int, ext_rows: int,
                        count: int, leader: int, round_idx: int, seed: int,
-                       key_space: int = 1 << 20) -> MsgBatch:
+                       key_space: int = 1 << 20, hot_pct: int = 0,
+                       hot_keys: int = 8) -> MsgBatch:
     """The host injector: NumPy twin of ``propose_batch``, row-for-row
     and byte-for-byte identical from the same (seed, round). This is
     what ``BENCH_RESIDENT=0`` feeds the cluster from the host, and the
@@ -195,6 +216,14 @@ def propose_batch_host(n_replicas: int, n_shards: int, ext_rows: int,
         colu = np.arange(m, dtype=np.uint32)[None, :]
         key = ((b0[:, :1] + colu * np.uint32(_KEY_STRIDE))
                & np.uint32(key_space - 1)).astype(np.int32)[:, None, :]
+        if hot_pct:
+            h0, h1 = threefry2x32_host(
+                seed, round_idx,
+                np.arange(g, dtype=np.int32)[:, None] + np.int32(g),
+                np.arange(m, dtype=np.int32)[None, :])
+            redirect = (h0 % np.uint32(100)) < np.uint32(hot_pct)
+            hot = (h1 % np.uint32(hot_keys)).astype(np.int32)
+            key = np.where(redirect, hot, key[:, 0, :])[:, None, :]
     val = b1.astype(np.int32)[:, None, :]
     z = np.zeros((g, r, m), np.int32)
     with np.errstate(over="ignore"):

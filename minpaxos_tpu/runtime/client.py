@@ -45,10 +45,23 @@ from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
 
 def gen_workload(n: int, conflict_pct: int = 0, key_range: int = 100000,
                  zipf_s: float = 0.0, write_pct: int = 100,
-                 seed: int = 42) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+                 seed: int = 42, profile=None,
+                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Pre-generated request arrays (ops, keys, vals) — the reference
     pre-builds karray/put with conflict-% or Zipfian keys
-    (client.go:68-103; seed 42 at :45)."""
+    (client.go:68-103; seed 42 at :45).
+
+    ``profile`` (a ``soak.profiles`` name, dict, or WorkloadProfile)
+    switches to the paxsoak generator family: EXACT finite-support
+    Zipf, read/write mix and value-size envelope, byte-reproducible
+    from ``seed``. The legacy knobs are ignored in that mode (numpy's
+    ``rng.zipf`` here samples the unbounded Zeta distribution — kept
+    for bench continuity, superseded by the profiles)."""
+    if profile is not None:
+        # soak.profiles imports nothing from runtime — no cycle
+        from minpaxos_tpu.soak.profiles import (profile_rows,
+                                                resolve_profile)
+        return profile_rows(resolve_profile(profile), n, seed)
     rng = np.random.default_rng(seed)
     if zipf_s > 0:
         keys = (rng.zipf(zipf_s, n) - 1) % key_range

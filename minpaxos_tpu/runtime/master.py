@@ -104,7 +104,8 @@ class Master:
 
     def _handle(self, req: dict) -> dict:
         m = req.get("m")
-        if m in ("stats", "trace", "chaos", "tracespans", "events"):
+        if m in ("stats", "trace", "chaos", "tracespans", "events",
+                 "phase"):
             # paxmon/paxchaos fan-out verbs: these poll every replica's
             # control socket, so they must NOT run under the membership
             # lock — one slow replica's 2 s control timeout would stall
@@ -158,6 +159,10 @@ class Master:
             sub = {"m": m}
         elif m == "trace":
             sub = {"m": "trace", "last": req.get("last")}
+        elif m == "phase":
+            sub = {"m": "phase", "ordinal": req.get("ordinal", 0),
+                   "kind_id": req.get("kind_id", 0),
+                   "duration_ms": req.get("duration_ms", 0)}
         else:
             sub = {"m": "chaos", "op": req.get("op", "status"),
                    "plan": req.get("plan")}
@@ -204,6 +209,13 @@ class Master:
             # read-only "status" keeps the dead-replica-tolerant
             # contract above — a crashed replica contributes its
             # error stanza, not a fan-out failure
+            out["ok"] = (len(replicas) == self.n
+                         and all(bool(r.get("ok")) for r in replicas))
+        if m == "phase":
+            # same all-n contract as chaos install/clear: a phase
+            # boundary is ground truth the soak scorecard joins
+            # detector raises against, so it must exist on EVERY
+            # replica's journal or the fan-out fails loudly
             out["ok"] = (len(replicas) == self.n
                          and all(bool(r.get("ok")) for r in replicas))
         if m == "trace":
@@ -368,6 +380,20 @@ def cluster_chaos(maddr: tuple[str, int], op: str = "status",
     acknowledged — a partial install must fail loudly, not leave half
     the cluster faulted behind a 'healed' campaign."""
     return _rpc(maddr, {"m": "chaos", "op": op, "plan": plan},
+                timeout=timeout_s)
+
+
+def cluster_phase(maddr: tuple[str, int], ordinal: int, kind_id: int,
+                  duration_ms: int = 0,
+                  timeout_s: float = 15.0) -> dict:
+    """paxsoak fan-out: journal an ``EV_PHASE`` scenario-phase
+    boundary on EVERY replica (subject = phase ordinal, aux =
+    ``obs.watch.PHASE_KIND_IDS`` id, value = planned duration ms), so
+    phase edges land in the same monotonic event domain as detector
+    raises/clears and chaos installs. All-n semantics like a chaos
+    install: ``ok`` only if every replica journaled the edge."""
+    return _rpc(maddr, {"m": "phase", "ordinal": ordinal,
+                        "kind_id": kind_id, "duration_ms": duration_ms},
                 timeout=timeout_s)
 
 

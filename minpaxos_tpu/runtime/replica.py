@@ -71,6 +71,7 @@ from minpaxos_tpu.obs.watch import (
     EV_FATAL,
     EV_LEADER_CHANGE,
     EV_NARROW_FALLBACK,
+    EV_PHASE,
     EV_STORE_CORRUPT,
     EventJournal,
     burn_alarm,
@@ -785,6 +786,17 @@ class ReplicaServer:
                     # partition can be flipped mid-workload; status
                     # reports per-kind injected-fault tallies.
                     resp = self._chaos_verb(req)
+                elif m == "phase":
+                    # paxsoak verb: journal a scenario-phase boundary
+                    # (EV_PHASE) on THIS replica's journal so phase
+                    # edges share the detector/chaos monotonic domain.
+                    # Journaled from this control thread's own ring,
+                    # the established _chaos_verb pattern.
+                    self.journal.record(
+                        EV_PHASE, subject=int(req.get("ordinal", 0)),
+                        value=int(req.get("duration_ms", 0)),
+                        aux=int(req.get("kind_id", 0)))
+                    resp = {"ok": True, "id": self.me}
                 elif m == "be_the_leader":
                     self.queue.put((CONTROL, 0, "be_the_leader", None))
                     resp = {"ok": True}

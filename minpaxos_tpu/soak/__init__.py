@@ -1,0 +1,21 @@
+"""paxsoak — scenario-driven soak harness.
+
+Composes the machinery the previous PRs built one piece at a time —
+ClientSwarm's real TCP sessions, paxchaos fault plans, paxwatch
+journals/detectors, paxtrace stage tables — into phased soak runs
+whose output is ONE joined observability record (``SOAK.json``):
+
+* profiles  — named workload profiles (exact Zipf hot-key skew,
+              read/write mix, value-size distribution) and a seeded
+              open-loop arrival process (Poisson + diurnal/burst
+              envelope). numpy + stdlib only.
+* swarm     — OpenLoopSwarm: ClientSwarm's selector loop sharded
+              across worker processes, deadline-based open-loop
+              injection, per-shard exactly-once accounting merged at
+              the driver. Workers import no JAX.
+* scenario  — the declarative phase manifest + driver + scorecard
+              join (phases vs detector raise/clear vs ground-truth
+              fault windows vs traced stage tables).
+
+Entry point: ``tools/soak.py`` (``--smoke`` / ``--full``).
+"""

@@ -1,0 +1,339 @@
+"""paxsoak units (ISSUE 18): exact-Zipf profiles pinned against the
+closed-form mass, byte-reproducible open-loop arrival schedules, the
+hot-key workload knob's device/host equivalence, EV_PHASE journaling
+and the paxtop SOAK stanza, the scorecard's alarm-classification /
+criteria join on synthetic timelines, and a small multi-process
+OpenLoopSwarm exactly-once leg against a real in-process cluster
+(the chaos-smoke compiled shape — no new variants).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.obs import watch as W
+from minpaxos_tpu.soak.profiles import (
+    OP_GET,
+    OP_PUT,
+    PROFILES,
+    ArrivalSpec,
+    WorkloadProfile,
+    arrival_times,
+    profile_rows,
+    resolve_profile,
+    sample_zipf,
+    zipf_pmf,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ------------------------------------------------------- exact Zipf
+
+def test_zipf_pmf_closed_form():
+    pmf = zipf_pmf(1024, 1.2)
+    assert pmf.shape == (1024,)
+    assert abs(pmf.sum() - 1.0) < 1e-12
+    # p(k) proportional to k^-s: pin the ratio, not the normalizer
+    assert pmf[0] / pmf[1] == pytest.approx(2.0 ** 1.2, rel=1e-12)
+    assert np.all(np.diff(pmf) < 0)  # strictly rank-decreasing
+
+
+def test_zipf_sample_mass_pinned_against_closed_form():
+    """The sampler is EXACT finite-support Zipf: empirical mass of the
+    hottest ranks matches the closed-form pmf within sampling noise
+    (this is the property numpy's unbounded rng.zipf cannot give)."""
+    n, n_keys, s = 200_000, 1024, 1.2
+    rng = np.random.default_rng(99)
+    ranks = sample_zipf(n, n_keys, s, rng)
+    assert ranks.min() >= 0 and ranks.max() < n_keys
+    pmf = zipf_pmf(n_keys, s)
+    for top in (1, 8, 64):
+        want = pmf[:top].sum()
+        got = float(np.mean(ranks < top))
+        # ~4.5 sigma of a Bernoulli(want) mean over n draws
+        tol = 4.5 * np.sqrt(want * (1 - want) / n)
+        assert abs(got - want) < tol, (top, got, want, tol)
+
+
+def test_zipf_sampler_deterministic():
+    a = sample_zipf(1000, 256, 1.8, np.random.default_rng(7))
+    b = sample_zipf(1000, 256, 1.8, np.random.default_rng(7))
+    assert np.array_equal(a, b)
+
+
+# --------------------------------------------------------- profiles
+
+def test_profile_rows_reproducible_and_shaped():
+    prof = PROFILES["mixed"]  # zipf_s=0.9, write_pct=50
+    ops, keys, vals = profile_rows(prof, 20_000, seed=5)
+    ops2, keys2, vals2 = profile_rows(prof, 20_000, seed=5)
+    assert (np.array_equal(ops, ops2) and np.array_equal(keys, keys2)
+            and np.array_equal(vals, vals2))
+    assert set(np.unique(ops)) <= {OP_PUT, OP_GET}
+    wfrac = float(np.mean(ops == OP_PUT))
+    assert abs(wfrac - 0.50) < 0.02
+    assert keys.min() >= 0 and keys.max() < prof.key_space
+    # log-uniform value magnitudes stay inside the configured octaves
+    assert vals.min() >= 1 << prof.val_pow2_min
+    assert vals.max() < 1 << prof.val_pow2_max
+
+
+def test_profile_resolve_and_roundtrip():
+    p = resolve_profile("hot_zipf")
+    assert p is PROFILES["hot_zipf"]
+    assert resolve_profile(p.to_dict()) == p
+    assert resolve_profile(p) is p
+    with pytest.raises(ValueError, match="unknown profile"):
+        resolve_profile("nope")
+
+
+def test_profile_op_codes_mirror_wire():
+    from minpaxos_tpu.wire.messages import Op
+
+    assert OP_PUT == int(Op.PUT)
+    assert OP_GET == int(Op.GET)
+
+
+def test_gen_workload_profile_hook():
+    from minpaxos_tpu.runtime.client import gen_workload
+
+    got = gen_workload(512, seed=11, profile="hot_zipf")
+    want = profile_rows(PROFILES["hot_zipf"], 512, seed=11)
+    for g, w in zip(got, want):
+        assert np.array_equal(g, w)
+
+
+# --------------------------------------------------------- arrivals
+
+def test_arrival_schedule_byte_reproducible():
+    spec = ArrivalSpec(rate_hz=200.0, duration_s=8.0, burst_x=5.0,
+                       burst_t0_frac=0.25, burst_t1_frac=0.5,
+                       diurnal_amp=0.3, diurnal_period_s=4.0)
+    a = arrival_times(spec, seed=42)
+    b = arrival_times(spec, seed=42)
+    assert a.dtype == np.float64 and np.array_equal(a, b)
+    assert not np.array_equal(a, arrival_times(spec, seed=43))
+    assert np.all(np.diff(a) >= 0) and a[0] >= 0 and a[-1] < 8.0
+    # round-trips through the manifest dict form unchanged
+    assert ArrivalSpec.from_dict(spec.to_dict()) == spec
+
+
+def test_arrival_burst_envelope_density():
+    """The burst window really carries burst_x times the base rate
+    (Poisson-thinned, so checked within sampling noise)."""
+    spec = ArrivalSpec(rate_hz=400.0, duration_s=10.0, burst_x=4.0,
+                       burst_t0_frac=0.2, burst_t1_frac=0.4)
+    t = arrival_times(spec, seed=9)
+    in_burst = np.sum((t >= 2.0) & (t < 4.0))
+    outside = len(t) - in_burst
+    # expected: 2 s at 1600 Hz = 3200 vs 8 s at 400 Hz = 3200
+    assert in_burst == pytest.approx(3200, abs=5 * np.sqrt(3200))
+    assert outside == pytest.approx(3200, abs=5 * np.sqrt(3200))
+    # per-second density ratio is the burst multiplier
+    assert (in_burst / 2.0) / (outside / 8.0) == pytest.approx(4.0,
+                                                               rel=0.15)
+
+
+def test_arrival_rate_envelope_math():
+    spec = ArrivalSpec(rate_hz=100.0, duration_s=10.0, burst_x=6.0,
+                       burst_t0_frac=0.5, burst_t1_frac=0.6,
+                       diurnal_amp=0.5, diurnal_period_s=10.0)
+    assert spec.peak_rate == pytest.approx(100.0 * 1.5 * 6.0)
+    r = spec.rate_at(np.array([0.0, 2.5, 5.5, 7.5]))
+    assert r[0] == pytest.approx(100.0)          # sin(0) = 0
+    assert r[1] == pytest.approx(150.0)          # diurnal crest
+    assert r[2] == pytest.approx(600.0 * (1 + 0.5 * np.sin(2 * np.pi
+                                                           * 0.55)))
+    assert r[3] == pytest.approx(50.0)           # diurnal trough
+    assert len(arrival_times(ArrivalSpec(rate_hz=0.0), 1)) == 0
+
+
+# --------------------------------------- hot-key knob (ops/workload)
+
+def test_hot_key_knob_device_host_equivalence():
+    """paxsoak's hot-key-skew knob: the device generator and its host
+    twin stay row-for-row identical with the knob engaged, and the
+    redirect actually concentrates keys into the hot set."""
+    from minpaxos_tpu.ops.workload import propose_batch, propose_batch_host
+
+    g, r, m = 2, 3, 32
+    hot_frac = []
+    for rnd in (0, 7):
+        dev = propose_batch(r, g, m, m, 1, rnd, 123,
+                            key_space=1 << 10, hot_pct=30, hot_keys=4)
+        host = propose_batch_host(r, g, m, m, 1, rnd, 123,
+                                  key_space=1 << 10, hot_pct=30,
+                                  hot_keys=4)
+        for f in dev._fields:
+            assert np.array_equal(np.asarray(getattr(dev, f)),
+                                  getattr(host, f)), (f, rnd)
+        hot_frac.append(np.mean(host.key_lo[:, 1, :] < 4))
+    # skew is real: with hot_pct=30 well over the uniform baseline
+    # (4/1024) of keys land in the 4 hot slots
+    assert np.mean(hot_frac) > 0.15, hot_frac
+
+
+# ------------------------------------- EV_PHASE + the paxtop stanza
+
+def test_ev_phase_journal_roundtrip():
+    assert W.EVENT_NAMES[W.EV_PHASE] == "phase"
+    assert W.PHASE_KIND_IDS["overload"] == W.PHASE_OVERLOAD
+    assert W.PHASE_KIND_NAMES[W.PHASE_KIND_IDS["partition"]] == "partition"
+    j = W.EventJournal(capacity=16)
+    j.record(W.EV_PHASE, subject=2, value=12_000,
+             aux=W.PHASE_KIND_IDS["overload"])
+    col = j.collect()
+    assert W.counts_by_kind(col["events"])["phase"] == 1
+    ev = col["events"][-1]
+    assert (int(ev[W.EV_SUBJECT]), int(ev[W.EV_VALUE]),
+            int(ev[W.EV_AUX])) == (2, 12_000, W.PHASE_OVERLOAD)
+
+
+def _load_paxtop():
+    spec = importlib.util.spec_from_file_location(
+        "paxtop_soak_mod", REPO / "tools" / "paxtop.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_paxtop_soak_stanza():
+    """The SOAK stanza reads the NEWEST EV_PHASE stamp (phase name
+    from the kind table, elapsed from its wall time, planned from the
+    journaled duration) and is None when no scenario ever ran."""
+    paxtop = _load_paxtop()
+    j = W.EventJournal(capacity=16)
+    j.record(W.EV_PHASE, subject=0, value=4_000,
+             aux=W.PHASE_KIND_IDS["warmup"])
+    j.record(W.EV_PHASE, subject=1, value=6_000,
+             aux=W.PHASE_KIND_IDS["overload"])
+    ev_resp = {"ok": True, "replicas": [
+        {"id": 0, "ok": True, "journal": j.collect()}]}
+    resp = {"ok": True, "leader": 0, "replicas": [
+        {"id": 0, "ok": True, "frontier": 1,
+         "metrics": {"counters": {}, "gauges": {}}}]}
+    payload = paxtop.snapshot_payload(resp, ev_resp, None, 0.0,
+                                      now_wall_ns=time.time_ns())
+    soak = payload["soak"]
+    assert set(paxtop.SOAK_ROW_KEYS) == set(soak), sorted(soak)
+    assert soak["ordinal"] == 1 and soak["phase"] == "overload"
+    assert soak["planned_s"] == pytest.approx(6.0)
+    assert 0.0 <= soak["elapsed_s"] < 5.0
+    # idle cluster: no EV_PHASE anywhere -> stanza is None, key stays
+    empty = paxtop.snapshot_payload(
+        resp, {"ok": True, "replicas": []}, None, 0.0,
+        now_wall_ns=time.time_ns())
+    assert empty["soak"] is None and "soak" in paxtop.JSON_PAYLOAD_KEYS
+
+
+# ------------------------------------------- scorecard join/criteria
+
+def _synthetic_card(warmup_shed=0, overload_shed=500, alarms=(),
+                    edges=(), lost=0):
+    phases = [
+        {"ordinal": 0, "name": "warmup", "kind": "warmup",
+         "t0_wall": 100.0, "t1_wall": 108.0,
+         "cluster": {"coalesce_admission_rejects": warmup_shed}},
+        {"ordinal": 1, "name": "burst", "kind": "overload",
+         "t0_wall": 108.0, "t1_wall": 120.0,
+         "cluster": {"coalesce_admission_rejects": overload_shed}},
+        {"ordinal": 2, "name": "part", "kind": "partition",
+         "t0_wall": 120.0, "t1_wall": 134.0,
+         "cluster": {"coalesce_admission_rejects": 0}},
+        {"ordinal": 3, "name": "heal", "kind": "heal",
+         "t0_wall": 134.0, "t1_wall": 142.0,
+         "cluster": {"coalesce_admission_rejects": 0}},
+    ]
+    return {"phases": phases, "alarms": list(alarms),
+            "alarm_edges": list(edges),
+            "fault_windows": [{"t_install": 122.0, "t_clear": 130.0,
+                               "grace_s": 3.0}],
+            "exactly_once": {"lost": lost, "acked_unique": 10_000}}
+
+
+def test_classify_alarms_against_ground_truth():
+    from minpaxos_tpu.soak.scenario import classify_alarms
+
+    card = _synthetic_card()
+    alarms = [
+        # raised mid-partition, cleared after the fault cleared
+        {"detector": "frontier_stall", "subject": 2,
+         "t_raised": 124.0, "t_cleared": 131.0},
+        # raised during warmup: not in any fault window
+        {"detector": "p99_burn_rate", "subject": 0,
+         "t_raised": 101.0, "t_cleared": 102.0},
+        # raised in-window but never cleared
+        {"detector": "backlog_growth", "subject": 2,
+         "t_raised": 125.0, "t_cleared": None},
+    ]
+    out = classify_alarms(alarms, card["phases"], card["fault_windows"])
+    assert [a["phase"] for a in out] == ["part", "warmup", "part"]
+    assert [a["in_fault_window"] for a in out] == [True, False, True]
+    assert [a["cleared_after_heal"] for a in out] == [True, True, False]
+
+
+def test_evaluate_criteria_joined_timeline():
+    from minpaxos_tpu.soak.scenario import evaluate_criteria
+
+    good = _synthetic_card(
+        alarms=[{"detector": "frontier_stall", "subject": 2,
+                 "t_raised": 124.0, "t_cleared": 131.0,
+                 "phase": "part", "in_fault_window": True,
+                 "cleared_after_heal": True}],
+        edges=[{"detector": "p99_burn_rate", "wall_s": 110.0}])
+    crit = evaluate_criteria(good)
+    assert crit == {"admission_organic": True,
+                    "overload_alarm_journaled": True,
+                    "partition_detected_in_window": True,
+                    "exactly_once": True, "ok": True}
+    # shed outside the overload phase is NOT organic
+    crit = evaluate_criteria(_synthetic_card(warmup_shed=3,
+                                             alarms=good["alarms"],
+                                             edges=good["alarm_edges"]))
+    assert not crit["admission_organic"] and not crit["ok"]
+    # a lost command sinks exactly-once
+    crit = evaluate_criteria(_synthetic_card(lost=1,
+                                             alarms=good["alarms"],
+                                             edges=good["alarm_edges"]))
+    assert not crit["exactly_once"] and not crit["ok"]
+    # a partition phase with zero watcher alarms is NOT a pass
+    crit = evaluate_criteria(_synthetic_card(edges=good["alarm_edges"]))
+    assert not crit["partition_detected_in_window"]
+
+
+# -------------------------------------- multi-process swarm (real IO)
+
+def test_open_loop_swarm_exactly_once(tmp_path):
+    """2 worker processes x 8 sessions of seeded open-loop traffic
+    against a real in-process cluster: every injected command acked
+    exactly once across shards after the drain (0 lost), duplicates
+    absorbed client-side. Same compiled cluster shape as the chaos
+    smoke / test_swarm — no new variants."""
+    from minpaxos_tpu.chaos.campaign import ChaosCluster
+    from minpaxos_tpu.soak.swarm import OpenLoopSwarm
+
+    cluster = ChaosCluster(n=3, store_dir=str(tmp_path))
+    swarm = None
+    try:
+        swarm = OpenLoopSwarm(cluster.maddr, sessions=16, shards=2,
+                              retransmit_s=0.5, trace_pow2=None)
+        swarm.start()
+        res = swarm.run_phase(
+            "mixed", ArrivalSpec(rate_hz=150.0, duration_s=3.0), seed=11)
+        assert res["sent"] > 200, res  # open loop: ~450 expected
+        drain = swarm.drain(20.0)
+        final = swarm.stop()
+        swarm = None
+        assert final["lost"] == 0, (res, drain, final)
+        assert final["acked_unique"] == final["sent_unique"] > 0, final
+        assert final["dead_sessions"] == 0, final
+    finally:
+        if swarm is not None:
+            swarm.kill()
+        cluster.stop()

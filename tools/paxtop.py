@@ -15,9 +15,12 @@ paxwatch HEALTH column (the newest WARN-or-worse journal event per
 replica + its age). Below the table, an EVENTS tail pane shows the
 newest cluster journal events (elections, leader changes, chaos
 installs, store-corruption recoveries, alarms) from the master's
-``events`` fan-out. ``--once --json`` emits the whole model —
-response / derived / events / health — under the stable key schema
-pinned in tests/test_paxwatch.py (OBSERVABILITY.md documents it).
+``events`` fan-out. When a paxsoak scenario (tools/soak.py) is
+stamping EV_PHASE events, the header grows a SOAK stanza — current
+phase name, ordinal, elapsed vs planned seconds. ``--once --json``
+emits the whole model — response / derived / events / health / soak —
+under the stable key schema pinned in tests/test_paxwatch.py
+(OBSERVABILITY.md documents it).
 
     python tools/paxtop.py -mport 7087              # live, 1s refresh
     python tools/paxtop.py -mport 7087 -i 0.5       # faster refresh
@@ -58,6 +61,7 @@ from minpaxos_tpu.obs.watch import (  # noqa: E402
     EV_VALUE,
     EV_WALL,
     EVENT_NAMES,
+    PHASE_KIND_NAMES,
     SEV_NAMES,
     SEV_WARN,
 )
@@ -73,7 +77,7 @@ _REGIMES = ("full_steps", "fused_dispatches", "narrow_steps")
 #: tests/test_paxwatch.py; OBSERVABILITY.md documents it). Consumers
 #: may rely on these being present; additions are fine, removals and
 #: renames are a breaking change.
-JSON_PAYLOAD_KEYS = ("response", "derived", "events", "health")
+JSON_PAYLOAD_KEYS = ("response", "derived", "events", "health", "soak")
 DERIVED_ROW_KEYS = (
     "id", "ok", "role", "protocol", "frontier", "lag", "fatal", "error",
     "dispatches", "ticks", "idle_skips", "committed", "chaos_injected",
@@ -82,6 +86,7 @@ DERIVED_ROW_KEYS = (
     "coalesce", "health")
 EVENT_ROW_KEYS = ("rid", "t_wall_s", "age_s", "kind", "severity",
                   "subject", "value", "aux", "trace_id")
+SOAK_ROW_KEYS = ("ordinal", "phase", "elapsed_s", "planned_s", "rid")
 
 
 def _derive_events(ev_resp: dict, now_wall_ns: int,
@@ -121,6 +126,26 @@ def _derive_events(ev_resp: dict, now_wall_ns: int,
     return rows if last is None else rows[-last:]
 
 
+def _derive_soak(event_rows: list[dict]) -> dict | None:
+    """SOAK stanza: the newest ``EV_PHASE`` journal event — which
+    paxsoak scenario phase the cluster is in, how long it has been
+    running, and the manifest's planned duration. None when no soak
+    scenario has stamped the journals (the common idle case)."""
+    newest = None
+    for e in event_rows:  # newest-last: later rows overwrite
+        if e["kind"] == "phase":
+            newest = e
+    if newest is None:
+        return None
+    kid = newest["aux"]
+    return {"ordinal": newest["subject"],
+            "phase": (PHASE_KIND_NAMES[kid]
+                      if 0 <= kid < len(PHASE_KIND_NAMES) else str(kid)),
+            "elapsed_s": newest["age_s"],
+            "planned_s": newest["value"] / 1e3,
+            "rid": newest["rid"]}
+
+
 def _derive_health(event_rows: list[dict]) -> dict:
     """Per-replica HEALTH: the newest WARN-or-worse journal event
     ({rid: {kind, severity, age_s}}; absent rid = nothing loud)."""
@@ -152,7 +177,8 @@ def snapshot_payload(resp: dict, ev_resp: dict, prev: dict | None,
         row["health"] = health.get(row["id"])
     return {"response": resp, "derived": rows,
             "events": all_events[-64:],
-            "health": {str(k): v for k, v in health.items()}}
+            "health": {str(k): v for k, v in health.items()},
+            "soak": _derive_soak(all_events)}
 
 
 def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
@@ -254,14 +280,21 @@ def _fmt_health(h: dict | None) -> str:
 
 def _render(resp: dict, rows: list[dict], clear: bool,
             events: list[dict] | None = None,
-            tail_n: int = 6) -> None:
+            tail_n: int = 6, soak: dict | None = None) -> None:
     out = []
     if clear:
         out.append("\x1b[2J\x1b[H")
     alive = sum(1 for r in rows if r["ok"])
-    out.append(f"paxtop — {alive}/{len(rows)} replicas up, "
-               f"leader={resp.get('leader')}   "
-               f"{time.strftime('%H:%M:%S')}")
+    header = (f"paxtop — {alive}/{len(rows)} replicas up, "
+              f"leader={resp.get('leader')}   "
+              f"{time.strftime('%H:%M:%S')}")
+    if soak:
+        # paxsoak SOAK column: the scenario phase the cluster is in,
+        # from the newest EV_PHASE journal stamp (tools/soak.py)
+        header += (f"   SOAK phase#{soak['ordinal']} {soak['phase']} "
+                   f"+{soak['elapsed_s']:.0f}s"
+                   f"/{soak['planned_s']:.0f}s")
+    out.append(header)
     hdr = (f"{'ID':>2} {'ROLE':<8} {'ST':<2} {'FRONTIER':>9} {'LAG':>6} "
            f"{'COMMIT/S':>9} {'BACKLOG':>8} {'DISP':>8} {'FULL%':>6} "
            f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'CHAOS':>7} "
@@ -370,7 +403,7 @@ def main(argv=None) -> int:
             print(json.dumps(payload), flush=True)
         else:
             _render(resp, payload["derived"], clear=not args.once,
-                    events=payload["events"])
+                    events=payload["events"], soak=payload["soak"])
         if args.once:
             return 0
         prev, t_prev = resp, now

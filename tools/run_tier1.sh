@@ -70,6 +70,16 @@ env JAX_PLATFORMS=cpu python tools/obs_smoke.py --resident || exit 1
 echo "== paxchaos smoke (2 seeded fault schedules + invariant checker) =="
 env JAX_PLATFORMS=cpu python tools/chaos.py --smoke || exit 1
 
+# paxsoak smoke seventh: the scenario driver end-to-end (ISSUE 18) —
+# a 2-phase manifest (warmup + a micro overload burst) through the
+# open-loop sharded swarm against a real cluster, checking EV_PHASE
+# landed on every replica's journal, exactly-once held across shards
+# (0 lost), and the joined scorecard is well-formed. Same compiled
+# cluster shape as the chaos smoke above (JAX + the dispatch variants
+# are warm); phase walls are manifest-fixed, ~40 s total.
+echo "== paxsoak smoke (2-phase open-loop scenario + joined scorecard) =="
+env JAX_PLATFORMS=cpu python tools/soak.py --smoke || exit 1
+
 # The concurrent-client swarm leg (ISSUE 15) rides the pytest suite
 # below: tests/test_swarm.py drives 64 real closed-loop TCP sessions
 # through the ingress coalescer against an in-process cluster (~18 s,

@@ -9,8 +9,10 @@ hand. This tool prints it once: per committed accelerator artifact
 `BENCH_TCP.json`) the headline throughput, quorum p50/p99, platform and
 shape — plus verification coverage from the model-checker artifacts
 (`MC.json`/`MC_FLEX.json`: refined edges, fair lassos, mutant
-self-tests) and the repo-growth trajectory from `PROGRESS.jsonl` (per
-driver round: commits, LoC). Report-only: reads the committed
+self-tests), the paxsoak scenario scorecard (`SOAK.json`: per-phase
+throughput / latency / admission shed / alarm classification from the
+committed chaos-under-load run) and the repo-growth trajectory from
+`PROGRESS.jsonl` (per driver round: commits, LoC). Report-only: reads the committed
 artifacts, writes nothing, imports no JAX — safe to run anywhere,
 cheap enough to paste into a PR description.
 
@@ -322,6 +324,57 @@ def collect_verify_rows(repo: Path = REPO) -> list[dict]:
     return rows
 
 
+def collect_soak_rows(repo: Path = REPO) -> dict | None:
+    """paxsoak scorecard (SOAK.json, tools/soak.py --full): the
+    per-phase join — offered vs acked throughput, client latency
+    percentiles, admission-gate shed, retransmits, and the detector
+    alarms classified against the ground-truth fault windows — plus
+    the exactly-once totals and the acceptance criteria stanza. One
+    committed artifact, rendered as one table."""
+    path = repo / "SOAK.json"
+    if not path.exists():
+        return None
+    try:
+        card = json.load(open(path))
+    except (OSError, json.JSONDecodeError) as e:
+        return {"artifact": path.name, "error": repr(e)[:60]}
+    alarms = card.get("alarms") or []
+    rows = []
+    for p in card.get("phases") or []:
+        cl = p.get("client") or {}
+        cu = p.get("cluster") or {}
+        lat = cl.get("lat_ms") or {}
+        dur = p.get("t1_wall", 0) - p.get("t0_wall", 0)
+        ph_alarms = [a for a in alarms if a.get("phase") == p.get("name")]
+        rows.append({
+            "phase": p.get("name"), "kind": p.get("kind"),
+            "dur_s": round(dur, 1),
+            "sent": cl.get("sent"), "acked": cl.get("acked"),
+            "acked_per_s": (round(cl.get("acked", 0) / dur, 1)
+                            if dur > 0 else None),
+            "retransmits": cl.get("retransmits"),
+            "shed": cu.get("coalesce_admission_rejects"),
+            "committed": cu.get("committed_slots"),
+            "p50_ms": lat.get("p50"), "p99_ms": lat.get("p99"),
+            "p999_ms": lat.get("p999"),
+            "alarms_in_window": sum(
+                1 for a in ph_alarms if a.get("in_fault_window")),
+            "alarms_outside": sum(
+                1 for a in ph_alarms if not a.get("in_fault_window")),
+        })
+    return {
+        "artifact": path.name,
+        "name": card.get("name"),
+        "rows": rows,
+        "exactly_once": card.get("exactly_once") or {},
+        "criteria": card.get("criteria") or {},
+        "alarm_counts": (card.get("watch") or {}).get("alarm_counts"),
+        "wall_s": card.get("wall_s"),
+        "mtime_utc": time.strftime(
+            "%Y-%m-%d", time.gmtime(os.path.getmtime(path))),
+    }
+
+
 def collect_progress(repo: Path = REPO) -> list[dict]:
     """Last PROGRESS.jsonl sample per driver round: commits and LoC at
     round end — the repo-growth axis the bench trajectory rides on."""
@@ -349,7 +402,8 @@ def _fmt_counts(d: dict | None) -> str:
     return " ".join(f"{k}:{v}" for k, v in sorted(d.items()))
 
 
-def render_markdown(bench, tcp, progress, health=None, verify=None) -> str:
+def render_markdown(bench, tcp, progress, health=None, verify=None,
+                    soak=None) -> str:
     out = ["## Cross-PR bench trajectory (device loop)", ""]
     hdr = ("| artifact | when | platform | resident | inst/s | p50 ms "
            "| p99 ms | concurrent | shape | note |")
@@ -441,6 +495,36 @@ def render_markdown(bench, tcp, progress, health=None, verify=None) -> str:
                 f"| {_fmt(v.get('fair_lassos'))} "
                 f"| {v.get('mutants_found') or '-'} "
                 f"| {_fmt(v.get('wall_s'))} |")
+    if soak:
+        out += ["", "## Soak scenario (paxsoak SOAK.json)", ""]
+        if soak.get("error"):
+            out += [f"{soak['artifact']}: {soak['error']}"]
+        else:
+            eo = soak.get("exactly_once") or {}
+            crit = soak.get("criteria") or {}
+            out += [
+                f"`{soak['artifact']}` run `{soak.get('name')}` "
+                f"({soak.get('mtime_utc', '-')}): "
+                f"acked {_fmt(eo.get('acked_unique'))}"
+                f"/{_fmt(eo.get('sent_unique'))} unique, "
+                f"lost {_fmt(eo.get('lost'))}, "
+                f"dup {_fmt(eo.get('duplicates'))}, "
+                f"criteria " + " ".join(
+                    f"{k}:{'y' if v else 'n'}"
+                    for k, v in sorted(crit.items())), "",
+                "| phase | kind | dur s | sent | acked | acked/s "
+                "| retx | shed | p50 ms | p99 ms | p999 ms "
+                "| alarms in/out window |",
+                "|" + "---|" * 12]
+            for r in soak.get("rows") or []:
+                out.append(
+                    f"| {r['phase']} | {r['kind']} | {r['dur_s']} "
+                    f"| {_fmt(r['sent'])} | {_fmt(r['acked'])} "
+                    f"| {_fmt(r['acked_per_s'])} "
+                    f"| {_fmt(r['retransmits'])} | {_fmt(r['shed'])} "
+                    f"| {_fmt(r['p50_ms'], 1)} | {_fmt(r['p99_ms'], 1)} "
+                    f"| {_fmt(r['p999_ms'], 1)} "
+                    f"| {r['alarms_in_window']}/{r['alarms_outside']} |")
     if progress:
         out += ["", "## Repo growth (PROGRESS.jsonl, per driver round)", "",
                 "| round | commits | LoC | wall h |", "|" + "---|" * 4]
@@ -465,13 +549,14 @@ def main(argv=None) -> int:
     progress = collect_progress(repo)
     health = collect_health_rows(repo)
     verify = collect_verify_rows(repo)
+    soak = collect_soak_rows(repo)
     if args.json:
         print(json.dumps({"bench": bench, "tcp": tcp,
                           "progress": progress, "health": health,
-                          "verify": verify},
+                          "verify": verify, "soak": soak},
                          indent=1))
     else:
-        print(render_markdown(bench, tcp, progress, health, verify))
+        print(render_markdown(bench, tcp, progress, health, verify, soak))
     return 0
 
 
