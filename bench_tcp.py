@@ -110,6 +110,13 @@ RUNTIME_KNOBS = {
     "n_replicas": os.environ.get("BENCH_TCP_N", "3"),
     "q1": os.environ.get("BENCH_TCP_Q1", "0"),
     "q2": os.environ.get("BENCH_TCP_Q2", "0"),
+    # paxdur snapshot/truncation policy (runtime/replica.py): inert on
+    # the default non-durable bench servers, but stamped so a
+    # durability A/B can never be misread against a record whose
+    # snapshot cadence (and its fsync/segment-swap pauses) differed
+    "snapshots": os.environ.get("BENCH_TCP_SNAP", "1") != "0",
+    "snap_every_bytes": os.environ.get("BENCH_TCP_SNAP_EVERY",
+                                       str(8 << 20)),
 }
 
 
@@ -132,6 +139,9 @@ def _knob_args(keyhint: int, trace_pow2: str | None = None) -> list:
     if not RUNTIME_KNOBS["overlap_exec"]:
         args.append("-nooverlapexec")
     args += ["-q1", RUNTIME_KNOBS["q1"], "-q2", RUNTIME_KNOBS["q2"]]
+    args += ["-snap-every", RUNTIME_KNOBS["snap_every_bytes"]]
+    if not RUNTIME_KNOBS["snapshots"]:
+        args.append("-nosnap")
     return args
 
 

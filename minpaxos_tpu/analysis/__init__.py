@@ -57,6 +57,7 @@ from minpaxos_tpu.analysis import (  # noqa: E402,F401  (registration)
     recompile_hazard,
     resident_loop,
     spec_sync,
+    store_contract,
     trace_hazard,
     wall_honesty,
     wire_contract,

@@ -1,0 +1,221 @@
+"""store-contract: stable-store record tags and framing drift detection.
+
+Production failure mode: the stable store's on-disk records are
+headerless packed structs (``[type u8][len u32][crc u32][payload]``),
+and the file *outlives the build that wrote it* — restart replays
+bytes a previous binary fsync'd, and snapshot catch-up ships the same
+framing peer-to-peer. A renumbered record tag or a resized row doesn't
+error, it reinterprets bytes: the CRC certifies the payload wasn't
+*flipped*, not that the reader agrees what it *means*. So the check
+mirrors wire-contract, against the ledger in store_golden.py:
+
+1. **collision-free** — no two ``REC_*`` tags share a value (replay
+   dispatches on the tag byte; a duplicate silently merges two record
+   schemas);
+2. **append-only vs the golden ledger** — every recorded tag keeps its
+   value; new tags must not reuse recorded values and must be added to
+   the ledger in the same PR;
+3. **framing agreement** — the file magics, the record/snapshot header
+   struct formats, and the packed row widths (SLOT_DT / SNAP_DT) match
+   the ledger.
+
+The row-width check *evaluates* runtime/stable.py (numpy + stdlib
+only, loaded standalone so no package ``__init__`` — and therefore no
+jax — is imported); everything else is AST.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+import types
+
+from minpaxos_tpu.analysis.core import Project, Violation, register
+
+RULE = "store-contract"
+
+STABLE_PATH = "minpaxos_tpu/runtime/stable.py"
+
+
+def _module_assigns(tree: ast.Module) -> dict[str, tuple[ast.expr, int]]:
+    """name -> (value expression, line) for module-level assignments."""
+    out: dict[str, tuple[ast.expr, int]] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            out[node.targets[0].id] = (node.value, node.lineno)
+    return out
+
+
+def _struct_fmt(expr: ast.expr) -> str | None:
+    """The format string of a ``struct.Struct("<BI")`` call, if any."""
+    if (isinstance(expr, ast.Call) and expr.args
+            and isinstance(expr.args[0], ast.Constant)
+            and isinstance(expr.args[0].value, str)):
+        return expr.args[0].value
+    return None
+
+
+def _eval_stable(src: str, path: str):
+    """Execute stable.py standalone (numpy + stdlib) and return the
+    module, or None on failure."""
+    mod = types.ModuleType("_paxlint_stable_store")
+    mod.__file__ = path
+    try:
+        exec(compile(src, path, "exec"), mod.__dict__)
+    # paxlint: disable=broad-except -- deliberately broad: fixture or
+    # drifted sources under test may raise anything; the row-width
+    # checks just degrade to AST-only
+    except Exception:
+        return None
+    return mod
+
+
+def check(stable_src: str,
+          golden_tags: dict[str, int],
+          golden_magics: dict[str, bytes],
+          golden_fmts: dict[str, str],
+          golden_rows: dict[str, int],
+          stable_path: str = STABLE_PATH) -> list[Violation]:
+    """The whole contract check, parameterized so tests can seed
+    drifted sources or alternative ledgers."""
+    out: list[Violation] = []
+    try:
+        tree = ast.parse(stable_src, filename=stable_path)
+    except SyntaxError:
+        return out  # the parse violation is reported centrally
+
+    assigns = _module_assigns(tree)
+    tags = {n: (v.value, line) for n, (v, line) in assigns.items()
+            if n.startswith("REC_") and isinstance(v, ast.Constant)
+            and isinstance(v.value, int)}
+    if not tags:
+        out.append(Violation(stable_path, 1, RULE,
+                             "REC_* record-tag registry not found"))
+        return out
+
+    # 1. collision-free (replay dispatches on the tag byte)
+    seen: dict[int, str] = {}
+    for name, (value, line) in sorted(tags.items(), key=lambda kv: kv[1][1]):
+        if value in seen:
+            out.append(Violation(
+                stable_path, line, RULE,
+                f"record-tag collision: {name} = {value} aliases "
+                f"{seen[value]} — replay parses every record of one "
+                "type with the other's payload layout"))
+        else:
+            seen[value] = name
+
+    # 2. append-only vs the golden ledger
+    golden_values = set(golden_tags.values())
+    for name, gvalue in golden_tags.items():
+        if name not in tags:
+            out.append(Violation(
+                stable_path, 1, RULE,
+                f"recorded store tag {name} (value {gvalue}) was "
+                "removed — the registry is append-only; fsync'd files "
+                "on disk still contain it"))
+            continue
+        value, line = tags[name]
+        if value != gvalue:
+            out.append(Violation(
+                stable_path, line, RULE,
+                f"record tag renumbered: {name} is {value}, ledger "
+                f"says {gvalue} — existing store files replay with "
+                "reinterpreted payloads"))
+    for name, (value, line) in tags.items():
+        if name in golden_tags:
+            continue
+        if value in golden_values:
+            out.append(Violation(
+                stable_path, line, RULE,
+                f"new record tag {name} reuses recorded value {value} "
+                "— append with a fresh value"))
+        else:
+            out.append(Violation(
+                stable_path, line, RULE,
+                f"new record tag {name} (value {value}) is not "
+                "recorded in the store ledger — run `tools/lint.py "
+                "--print-store-golden` and extend "
+                "analysis/store_golden.py in this PR"))
+
+    # 3a. file magics (replay dispatches v1/v2 framing on them)
+    for name, gmagic in golden_magics.items():
+        got = assigns.get(name)
+        if got is None:
+            out.append(Violation(
+                stable_path, 1, RULE,
+                f"file magic {name} was removed — files stamped with "
+                f"{gmagic!r} no longer replay"))
+            continue
+        v, line = got
+        if isinstance(v, ast.Constant) and isinstance(v.value, bytes) \
+                and v.value != gmagic:
+            out.append(Violation(
+                stable_path, line, RULE,
+                f"file magic {name} is {v.value!r}, ledger says "
+                f"{gmagic!r} — existing store files are rejected (or "
+                "parsed with the wrong framing) at restart"))
+
+    # 3b. header struct formats
+    for name, gfmt in golden_fmts.items():
+        got = assigns.get(name)
+        if got is None:
+            out.append(Violation(
+                stable_path, 1, RULE,
+                f"framing struct {name} was removed — ledger records "
+                f"format {gfmt!r}"))
+            continue
+        fmt = _struct_fmt(got[0])
+        if fmt is None:
+            continue  # not a struct.Struct literal; width check below
+        if fmt != gfmt:
+            out.append(Violation(
+                stable_path, got[1], RULE,
+                f"framing drift: {name} format {fmt!r} != recorded "
+                f"{gfmt!r} — old files misframe at the first record"))
+        else:
+            try:
+                struct.calcsize(fmt)
+            except struct.error:
+                out.append(Violation(
+                    stable_path, got[1], RULE,
+                    f"framing struct {name} format {fmt!r} is not a "
+                    "valid struct format"))
+
+    # 3c. packed row widths (evaluates the module; degrades to skip)
+    mod = _eval_stable(stable_src, stable_path)
+    if mod is not None:
+        for name, grows in golden_rows.items():
+            dt = getattr(mod, name, None)
+            if dt is None:
+                out.append(Violation(
+                    stable_path, 1, RULE,
+                    f"row dtype {name} was removed — ledger records "
+                    f"{grows}-byte rows"))
+                continue
+            size = int(dt.itemsize)
+            if size != grows:
+                line = assigns.get(name, (None, 1))[1]
+                out.append(Violation(
+                    stable_path, line, RULE,
+                    f"packed width drift: {name} rows are {size} "
+                    f"bytes, ledger says {grows} — fsync'd payloads "
+                    "reslice into garbage on replay"))
+    return out
+
+
+@register(RULE)
+def run(project: Project) -> list[Violation]:
+    from minpaxos_tpu.analysis.store_golden import (
+        GOLDEN_MAGICS,
+        GOLDEN_REC_TAGS,
+        GOLDEN_ROW_BYTES,
+        GOLDEN_STRUCT_FMTS,
+    )
+
+    stable = project.get(STABLE_PATH)
+    if stable is None:
+        return []  # fixture projects without a runtime layer
+    return check(stable.src, GOLDEN_REC_TAGS, GOLDEN_MAGICS,
+                 GOLDEN_STRUCT_FMTS, GOLDEN_ROW_BYTES)

@@ -1,0 +1,59 @@
+"""The frozen stable-store contract: record tags and framing widths.
+
+This file is the append-only ledger the store-contract pass checks
+``runtime/stable.py`` against. The store file is the one artifact that
+*outlives* the build that wrote it: a replica restarted onto a newer
+binary replays bytes its predecessor fsync'd, and snapshot catch-up
+(SNAP_META/SNAP_ROWS) ships the same framing between replicas that may
+be mid-rolling-upgrade. Records are headerless packed structs —
+``[type u8][len u32][crc u32][payload]`` — so a renumbered record tag
+or a resized row doesn't error, it *reinterprets bytes*: a build where
+REC_SNAPSHOT became 2 would replay every old frontier record as a
+snapshot header, and the CRC only guards against *flipped* bytes, not
+*reinterpreted* ones (the checksum of a frontier record is valid — the
+reader is simply wrong about what the payload means).
+
+Rules (same shape as wire_golden.py; see ANALYSIS.md):
+
+* every record tag below must still exist with the same value and the
+  rows it frames must keep their packed itemsize — renaming,
+  renumbering, or resizing is a violation;
+* NEW tags may be appended freely (with values not reusing any value
+  below) — after which they are added here, extending the ledger;
+* the file magics and the record/snapshot header formats are part of
+  the contract too: replay dispatches framing on them before it reads
+  a single record.
+
+To legitimately extend the contract, regenerate this table:
+``python tools/lint.py --print-store-golden`` emits the current tree's
+table; paste it here in the same PR that adds the record type.
+"""
+
+from __future__ import annotations
+
+# record-tag name -> value (stable.py module constants ``REC_*``)
+GOLDEN_REC_TAGS: dict[str, int] = {
+    "REC_SLOTS": 1,
+    "REC_FRONTIER": 2,
+    "REC_SNAPSHOT": 3,
+}
+
+# file magics: replay dispatches v1 (no CRC) vs v2 framing on these
+GOLDEN_MAGICS: dict[str, bytes] = {
+    "MAGIC_V1": b"MPXL0001",
+    "MAGIC": b"MPXL0002",
+}
+
+# struct formats framing every record / snapshot payload
+GOLDEN_STRUCT_FMTS: dict[str, str] = {
+    "_HDR": "<BI",  # record type, payload bytes
+    "_CRC": "<I",  # crc32(header || payload), v2 only
+    "_FRONTIER": "<i",  # committed_upto
+    "_SNAP_HDR": "<iqI",  # frontier, wall_ns, pair count
+}
+
+# packed row widths inside REC_SLOTS / REC_SNAPSHOT payloads
+GOLDEN_ROW_BYTES: dict[str, int] = {
+    "SLOT_DT": 34,
+    "SNAP_DT": 16,
+}

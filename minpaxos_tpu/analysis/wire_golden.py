@@ -49,6 +49,8 @@ GOLDEN_KINDS: dict[str, tuple[int, int | None]] = {
     "PREPARE_INST_REPLY": (25, 39),
     "SKIP": (28, 9),
     "TRACE_CTX": (32, 20),
+    "SNAP_META": (33, 13),
+    "SNAP_ROWS": (34, 20),
     "HANDSHAKE_CLIENT": (120, None),
     "HANDSHAKE_PEER": (121, None),
 }

@@ -23,6 +23,7 @@ Used by ``tools/chaos.py`` (CLI + CI smoke) and tests/test_chaos.py.
 
 from __future__ import annotations
 
+import os
 import shutil
 import tempfile
 import threading
@@ -116,6 +117,38 @@ def build_schedule(name: str, seed: int, n: int) -> list[tuple]:
                                     jitter_s=0.008,
                                     reorder=3).to_dict()),
                   (dur, "clear", None)]
+    elif name == "crash_restart_heal":
+        # kill a FOLLOWER process mid-load (buffered store bytes lost,
+        # kernel-reached bytes kept — stable.crash()), leave it dead
+        # long enough for the paxwatch dead-replica stall alarm to
+        # raise, then restart it on the SAME dirs: it must recover from
+        # snapshot + redo suffix, catch up over the wire, and converge
+        # byte-identical (the checker's slot-agreement over quiesced
+        # stores). Ops "kill"/"restart" are process faults the runner
+        # applies directly to the in-process cluster — no network shim.
+        victim = int(rng.integers(1, n))
+        t0 = 0.3 + float(rng.random()) * 0.2
+        # the corpse must stay down long enough for the dead-replica
+        # stall detector to see a full stall window of silence (0.6 s
+        # SLO window + the master's 0.3 s ping cadence + poll jitter)
+        down = 1.5 + float(rng.random()) * 0.5
+        events = [(t0, "kill", {"rid": victim}),
+                  (t0 + down, "restart", {"rid": victim})]
+    elif name == "torn_snapshot_recovery":
+        # same crash/restart arc, but the victim's store file is
+        # damaged while it is down — the tail torn off (a crash mid
+        # write) or one byte flipped (media corruption): replay must
+        # truncate/CRC-skip the damage, fall back to the previous
+        # snapshot where needed, and the replica still converges
+        victim = int(rng.integers(1, n))
+        t0 = 0.3 + float(rng.random()) * 0.2
+        down = 1.5 + float(rng.random()) * 0.5  # see crash_restart_heal
+        mode = "tear" if rng.random() < 0.5 else "bitflip"
+        events = [(t0, "kill", {"rid": victim}),
+                  (t0 + down * 0.5, "tear",
+                   {"rid": victim, "mode": mode,
+                    "nbytes": int(rng.integers(16, 512))}),
+                  (t0 + down, "restart", {"rid": victim})]
     elif name == "flex_partition":
         # the flexible-quorum non-intersection probe (ISSUE 16): cut
         # off EXACTLY the q2-sized minority {n-2, n-1} under load. The
@@ -138,7 +171,15 @@ def build_schedule(name: str, seed: int, n: int) -> list[tuple]:
 
 SCHEDULES = ("partition_heal", "isolated_leader", "flap", "loss_reorder",
              "one_way", "delay_jitter", "dup_storm", "mixed",
-             "flex_partition")
+             "flex_partition", "crash_restart_heal",
+             "torn_snapshot_recovery")
+
+#: schedules whose faults are PROCESS faults (kill/tear/restart applied
+#: by the runner to the in-process cluster, not network shims via the
+#: master fan-out): the fault count comes from the runner's own event
+#: tally and the chaos_install journal floor does not apply
+CRASH_SCHEDULES = frozenset({"crash_restart_heal",
+                             "torn_snapshot_recovery"})
 
 #: schedules whose fault makes commit progress IMPOSSIBLE while
 #: installed (leader cut off from every quorum): the runner verifies
@@ -158,6 +199,13 @@ STARVED_SCHEDULES = frozenset({"flex_partition"})
 #: the phase-2 quorum is a strict minority (quorum_golden.py)
 SCHEDULE_SHAPES: dict[str, dict] = {
     "flex_partition": {"n": 5, "q1": 4, "q2": 2},
+    # crash schedules need durable stores to recover from, and a small
+    # snapshot threshold so the few-second run actually checkpoints
+    # and truncates (the 8 MiB default would never trigger)
+    "crash_restart_heal": {"durable": True,
+                           "flags": {"snap_every_bytes": 32768}},
+    "torn_snapshot_recovery": {"durable": True,
+                               "flags": {"snap_every_bytes": 32768}},
 }
 
 
@@ -233,6 +281,53 @@ class ChaosCluster:
             self.stop()
             raise
 
+    def kill(self, rid: int) -> None:
+        """Crash one replica process: buffered (userspace) store bytes
+        are LOST, kernel-reached bytes survive — possibly torn
+        (StableStore.crash) — and the sockets drop without goodbye.
+        The server object stays in ``servers`` so stop() still reaps
+        its threads if the schedule never restarts it."""
+        self.servers[rid].crash()
+
+    def restart(self, rid: int) -> None:
+        """Boot a FRESH ReplicaServer on the victim's ports and store
+        dir — the crash-recovery path: replay snapshot + redo suffix
+        from disk, then catch up the rest over the wire. The master
+        kept the (host, port) registration; its ping loop sees the
+        replica alive again once the listener is back (transport's
+        bind retries cover the TIME_WAIT window)."""
+        from minpaxos_tpu.runtime.replica import ReplicaServer
+
+        self.servers[rid].stop()  # idempotent after crash()
+        s = ReplicaServer(rid, self.addrs, self.cfg, self._mk_flags())
+        s.start()
+        # single-key assignment, never a pop: the sampler thread
+        # iterates this dict concurrently and must not see it resize
+        self.servers[rid] = s
+
+    def store_path(self, rid: int) -> str:
+        # mirror of the ReplicaServer's own naming (runtime/replica.py)
+        return f"{self.store_dir}/stable-store-replica{rid}"
+
+    def tear_store(self, rid: int, mode: str = "tear",
+                   nbytes: int = 64) -> None:
+        """Damage a DEAD replica's store file: ``tear`` cuts the last
+        ``nbytes`` off (a crash mid-append/mid-snapshot), ``bitflip``
+        flips one bit ``nbytes`` before EOF (media corruption a CRC
+        must catch). Only meaningful between kill() and restart()."""
+        path = self.store_path(rid)
+        size = os.path.getsize(path)
+        if mode == "bitflip":
+            off = max(8, size - max(int(nbytes), 1))
+            with open(path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0x40]))
+        else:
+            with open(path, "r+b") as f:
+                f.truncate(max(8, size - int(nbytes)))
+
     def stores(self) -> dict[int, object]:
         return {i: s.store for i, s in self.servers.items()}
 
@@ -258,7 +353,8 @@ class ChaosCluster:
 def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
                  timeout_s: float = 60.0, log=print,
                  events: list[tuple] | None = None,
-                 q1: int = 0, q2: int = 0) -> dict:
+                 q1: int = 0, q2: int = 0, durable: bool = False,
+                 flags: dict | None = None) -> dict:
     """One schedule end-to-end; returns a JSON-able result dict whose
     ``ok`` is the conjunction of load completion, exactly-once replies,
     real fault injection (> 0), post-heal commit resumption,
@@ -290,8 +386,12 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
     # after it (client construction can time out on a busy host) runs
     # under the finally that stops it — a leaked master + N replica
     # threads would degrade every later run of the campaign
-    cluster = ChaosCluster(n=n, q1=q1, q2=q2)
+    cluster = ChaosCluster(n=n, q1=q1, q2=q2, durable=durable,
+                           flags=flags)
     cli = None
+    # process-fault targets (kill/restart/tear ride the event list as
+    # runner-applied ops, not master fan-outs)
+    victims = frozenset(p["rid"] for _, op, p in events if op == "kill")
 
     def sampler():
         while not stop_sampling.is_set():
@@ -348,10 +448,26 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
         # fault timeline the stall-detector assertion compares against
         # (wall joins the watcher's samples, mono the frontier samples)
         fault_marks: list[tuple[float, float, str]] = []
+        kills = 0
         for t_off, op, plan in events:
             delay = t0 + t_off - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
+            if op in ("kill", "tear", "restart"):
+                # process faults: applied by the runner to the
+                # in-process cluster itself — there is no network shim
+                # and no master fan-out to drive them through
+                rid = plan["rid"]
+                if op == "kill":
+                    cluster.kill(rid)
+                    kills += 1
+                elif op == "tear":
+                    cluster.tear_store(rid, mode=plan.get("mode", "tear"),
+                                       nbytes=plan.get("nbytes", 64))
+                else:
+                    cluster.restart(rid)
+                fault_marks.append((time.monotonic(), time.time(), op))
+                continue
             r = cluster_chaos(cluster.maddr, op=op, plan=plan)
             fault_marks.append((time.monotonic(), time.time(), op))
             if not r.get("ok"):
@@ -368,7 +484,10 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
             # would undercount faults_injected below
             result["error"] = f"final heal fan-out failed: {heal}"
             return result
-        result["faults_injected"] = sum(
+        # kills are faults too: a crash-only schedule injects nothing
+        # through the network shims, so the shim counters alone would
+        # (wrongly) read as "no fault ever landed"
+        result["faults_injected"] = kills + sum(
             r.get("faults_total", 0) for r in heal.get("replicas", []))
         if loader.is_alive():
             result["error"] = "load thread never finished"
@@ -390,7 +509,16 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
         stop_sampling.set()
         smp.join(timeout=2.0)
         # the watcher outlives the resume leg on purpose: a raised
-        # stall alarm must be observed CLEARING once commits resume
+        # stall alarm must be observed CLEARING once commits resume.
+        # Crash schedules get a short grace: the dead-replica alarm
+        # clears one poll AFTER the restarted replica catches up, and
+        # convergence can land between polls.
+        if name in CRASH_SCHEDULES:
+            grace = time.monotonic() + 3.0
+            while time.monotonic() < grace and any(
+                    a["t_cleared"] is None for a in watcher.alarms
+                    if a["detector"] == "frontier_stall"):
+                time.sleep(0.1)
         watcher.stop()
         result["fault_timeline"] = [
             {"t_rel_s": round(tm - t0, 3), "wall_s": tw, "op": op}
@@ -406,6 +534,12 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
             result["watch"]["stall"] = _stall_verdict(
                 watcher, fault_marks,
                 expected_subject=frozenset({n - 2, n - 1}))
+        elif name in CRASH_SCHEDULES:
+            # the dead replica's frontier goes dark while the cluster
+            # keeps committing: the stall alarm must NAME the corpse
+            # while it is down and CLEAR once the restart catches up
+            result["watch"]["stall"] = _stall_verdict(
+                watcher, fault_marks, expected_subject=victims)
         result["client_events"] = cli.journal.counts_by_kind()
         # cluster-wide EVENTS fan-out: the journals must show the
         # fault-plan installs/clears this schedule just drove
@@ -417,15 +551,44 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
             counts_by_kind,
         )
 
-        kinds = counts_by_kind(align_event_collections(
+        aligned = align_event_collections(
             [r["journal"] for r in ev_resp.get("replicas", [])
-             if r.get("ok") and r.get("journal")]))
+             if r.get("ok") and r.get("journal")])
+        kinds = counts_by_kind(aligned)
         result["cluster_events"] = kinds
+        if durable:
+            # the durability scorecard tools/trend.py rows key on:
+            # did snapshots happen, how much log did truncation free,
+            # how long did crash recovery take, where did disk end up
+            from minpaxos_tpu.obs.watch import (
+                EV_AUX, EV_KIND, EV_RECOVERY, EV_TRUNCATE, EV_VALUE)
+
+            trunc = aligned[aligned[:, EV_KIND] == EV_TRUNCATE]
+            rec = aligned[aligned[:, EV_KIND] == EV_RECOVERY]
+            result["durability"] = {
+                "snapshots": int(kinds.get("snapshot", 0)),
+                "truncations": int(trunc.shape[0]),
+                "bytes_freed": int(trunc[:, EV_VALUE].sum()),
+                "recovery_ms_max": (int(rec[:, EV_AUX].max())
+                                    if len(rec) else 0),
+                "log_bytes": {str(i): int(s.store.log_bytes())
+                              for i, s in cluster.servers.items()},
+                "store_base": {str(i): int(s.store.base)
+                               for i, s in cluster.servers.items()},
+            }
         time.sleep(0.3)  # quiesce: no in-flight appends under the checker
         with cli._lock:
             replies = dict(cli.replies)
+        # a crashed replica legitimately REGRESSES its observed
+        # frontier across the restart (sync=False loses the buffered
+        # tail; it re-earns those slots over the wire), so its sample
+        # series is exempt from the monotonicity check — the survivors'
+        # series still are checked, and slot agreement over the
+        # quiesced stores still covers the victim byte-for-byte
+        mono_samples = {i: s for i, s in samples.items()
+                        if i not in victims}
         report = check_cluster(
-            cluster.stores(), frontier_samples=samples,
+            cluster.stores(), frontier_samples=mono_samples,
             replies=replies, workload=(ops, keys, vals))
         result["check"] = report.to_dict()
         result["acked"] = sum(st["acked"] for st in chunk_stats)
@@ -436,10 +599,15 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
             result["stall_observed"] = _stalled_during_fault(
                 sample_t, samples, fault_marks)
         stall_live = True
-        if name in STALL_SCHEDULES or name in STARVED_SCHEDULES:
+        if (name in STALL_SCHEDULES or name in STARVED_SCHEDULES
+                or name in CRASH_SCHEDULES):
             sv = result["watch"]["stall"]
             stall_live = (sv["fired_in_window"] and sv["attributed"]
                           and sv["cleared"])
+        # the chaos_install journal floor only applies when the
+        # schedule actually drove a fan-out install — crash schedules
+        # inject process faults the shims never see
+        has_install = any(op == "install" for _, op, _ in events)
         result["ok"] = (report.ok and converged
                         and result["resumed_commits"]
                         and result["expected"] > 0
@@ -447,7 +615,8 @@ def run_schedule(name: str, seed: int, n: int = 3, ops_n: int = 400,
                         and result["faults_injected"] > 0
                         and result["duplicates"] == 0
                         and result.get("stall_observed", True)
-                        and kinds.get("chaos_install", 0) >= n
+                        and (not has_install
+                             or kinds.get("chaos_install", 0) >= n)
                         and stall_live)
         return result
     finally:
@@ -490,8 +659,12 @@ def _stall_verdict(watcher: HealthWatcher,
     flex_partition island)."""
     if not isinstance(expected_subject, (set, frozenset)):
         expected_subject = frozenset({expected_subject})
-    installs = [tw for _, tw, op in fault_marks if op == "install"]
-    clears = [tw for _, tw, op in fault_marks if op == "clear"]
+    # a kill opens a fault window the way an install does; a restart
+    # closes one the way a clear does (crash schedules)
+    installs = [tw for _, tw, op in fault_marks
+                if op in ("install", "kill")]
+    clears = [tw for _, tw, op in fault_marks
+              if op in ("clear", "restart")]
     stall = [a for a in watcher.alarms
              if a["detector"] == "frontier_stall"]
     lo = installs[0] if installs else float("inf")
@@ -556,7 +729,9 @@ def run_campaign(schedules: list[str], seeds: list[int], n: int = 3,
             r = run_schedule(name, seed, n=shape.get("n", n),
                              ops_n=ops_n, log=log,
                              q1=shape.get("q1", 0),
-                             q2=shape.get("q2", 0))
+                             q2=shape.get("q2", 0),
+                             durable=shape.get("durable", False),
+                             flags=shape.get("flags"))
         except Exception as e:  # paxlint: disable=broad-except
             # a crashed run must become a seeded failure verdict, not
             # abort the remaining schedules of a CI campaign

@@ -22,7 +22,9 @@ from minpaxos_tpu.verify.invariants import (  # noqa: F401
     check_frontier_monotonic,
     check_linearizable,
     check_log_agreement,
+    check_snapshot_agreement,
 )
 
 __all__ = ["CheckReport", "check_cluster", "check_frontier_monotonic",
-           "check_linearizable", "check_log_agreement"]
+           "check_linearizable", "check_log_agreement",
+           "check_snapshot_agreement"]

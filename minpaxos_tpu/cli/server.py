@@ -165,6 +165,22 @@ def main(argv=None) -> None:
                         " 0 = simple majority. Smaller q2 = fewer acks"
                         " per commit (Flexible Paxos), paid for at"
                         " leader change by a larger -q1")
+    p.add_argument("-snap-every", dest="snap_every", type=int,
+                   default=8 << 20,
+                   help="snapshot + truncate once the on-disk stable"
+                        " store grows this many bytes past the last"
+                        " snapshot (0 disables the size trigger); two"
+                        " snapshots are retained so a corrupt newest"
+                        " one falls back to the older + longer replay")
+    p.add_argument("-snap-interval", dest="snap_interval", type=float,
+                   default=0.0,
+                   help="also snapshot every this many seconds while"
+                        " new commands executed (0 = size trigger"
+                        " only)")
+    p.add_argument("-nosnap", action="store_true",
+                   help="disable snapshots + log truncation entirely"
+                        " (the stable store then grows unboundedly —"
+                        " the pre-snapshot behavior) — for A/Bs")
     p.add_argument("-storedir", default=".",
                    help="stable store directory")
     p.add_argument("-platform", default="cpu",
@@ -249,6 +265,9 @@ def main(argv=None) -> None:
                          trace_ring=args.tracering,
                          watch=not args.nowatch,
                          watch_ring=args.watchring,
+                         snapshots=not args.nosnap,
+                         snap_every_bytes=args.snap_every,
+                         snap_interval_s=args.snap_interval,
                          profile=prof)
     server = ReplicaServer(my_id, [tuple(n) for n in nodes], cfg, flags,
                            protocol=protocol)

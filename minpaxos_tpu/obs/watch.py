@@ -65,25 +65,34 @@ SEV_NAMES = ("info", "warn", "alert")
 (EV_NONE, EV_ELECTION, EV_LEADER_CHANGE, EV_CLIENT_FAILOVER,
  EV_CHAOS_INSTALL, EV_CHAOS_CLEAR, EV_STORE_CORRUPT,
  EV_NARROW_FALLBACK, EV_LATENCY_OVERFLOW, EV_PEER_DOWN, EV_PEER_UP,
- EV_FATAL, EV_ALARM, EV_ALARM_CLEAR, EV_PHASE) = range(15)
+ EV_FATAL, EV_ALARM, EV_ALARM_CLEAR, EV_PHASE, EV_SNAPSHOT,
+ EV_TRUNCATE, EV_RECOVERY) = range(18)
 EVENT_NAMES = ("none", "election", "leader_change", "client_failover",
                "chaos_install", "chaos_clear", "store_corrupt",
                "narrow_fallback", "latency_overflow", "peer_down",
-               "peer_up", "fatal", "alarm", "alarm_clear", "phase")
+               "peer_up", "fatal", "alarm", "alarm_clear", "phase",
+               # durability lifecycle (PR 20): snapshot taken (value =
+               # snapshot frontier, aux = log bytes after), redo log
+               # truncated (value = bytes freed, aux = log bytes
+               # after), crash-restart recovery completed (value =
+               # recovered frontier, aux = recovery wall ms)
+               "snapshot", "truncate", "recovery")
 
 #: per-event default severities (the recorder may override)
 EVENT_SEVERITY = (SEV_INFO, SEV_INFO, SEV_INFO, SEV_WARN, SEV_WARN,
                   SEV_INFO, SEV_ALERT, SEV_WARN, SEV_WARN, SEV_WARN,
-                  SEV_INFO, SEV_ALERT, SEV_ALERT, SEV_INFO, SEV_INFO)
+                  SEV_INFO, SEV_ALERT, SEV_ALERT, SEV_INFO, SEV_INFO,
+                  SEV_INFO, SEV_INFO, SEV_WARN)
 
 #: soak phase kinds (ride EV_PHASE events in the aux field; the
 #: subject field carries the phase ordinal within the scenario, the
 #: value field the planned duration in ms). Append-only like the kind
 #: table: SOAK.json and paxtop key on these ids.
 (PHASE_NONE, PHASE_WARMUP, PHASE_SKEW, PHASE_OVERLOAD,
- PHASE_PARTITION, PHASE_HEAL, PHASE_DRAIN, PHASE_CUSTOM) = range(8)
+ PHASE_PARTITION, PHASE_HEAL, PHASE_DRAIN, PHASE_CUSTOM,
+ PHASE_CRASH_RESTART) = range(9)
 PHASE_KIND_NAMES = ("none", "warmup", "skew", "overload", "partition",
-                    "heal", "drain", "custom")
+                    "heal", "drain", "custom", "crash_restart")
 PHASE_KIND_IDS = {n: i for i, n in enumerate(PHASE_KIND_NAMES)}
 
 #: detector ids (ride EV_ALARM/EV_ALARM_CLEAR events in the aux field)
@@ -445,6 +454,28 @@ def stall_alarm(samples: list[dict], stall_s: float = 1.0,
     last = win[-1]
     lags = {int(rid): last["tip"] - r["frontier"]
             for rid, r in last["replicas"].items() if r["ok"]}
+    # a DEAD minority is invisible to the lag maps (no frontier to
+    # lag with), yet it is the sharpest stall there is: a killed
+    # replica's control socket answers nothing while the survivors'
+    # tip moves on. Require it dead across the whole window so one
+    # timed-out poll doesn't page, and name the replica (the
+    # crash_restart chaos schedules' signature; clears on restart).
+    dead = [int(rid) for rid, r in last["replicas"].items()
+            if not r["ok"]
+            and not win[0]["replicas"].get(rid, {"ok": True})["ok"]]
+    if dead and len(dead) < len(last["replicas"]) // 2 + 1:
+        suspect = min(dead)
+        return {
+            "detector": "frontier_stall", "subject": suspect,
+            "evidence": {
+                "window_s": round(last["t"] - win[0]["t"], 3),
+                "tip_delta": tip_delta,
+                "proposals_delta": prop_delta,
+                "in_flight": last["in_flight"],
+                "lags": lags, "dead": dead,
+                "why": (f"replica {suspect} is down (no stats across "
+                        f"the window) while the tip "
+                        f"{'advanced' if tip_delta > 0 else 'held'}")}}
     if tip_delta > slack_slots:
         first_fr = {int(rid): r["frontier"]
                     for rid, r in win[0]["replicas"].items() if r["ok"]}

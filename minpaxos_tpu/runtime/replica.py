@@ -38,6 +38,7 @@ from minpaxos_tpu.chaos import ChaosShim, FaultPlan
 from minpaxos_tpu.models.minpaxos import (
     ACCEPTED,
     COMMITTED,
+    NO_BALLOT,
     MinPaxosConfig,
     MsgBatch,
     become_leader,
@@ -72,12 +73,15 @@ from minpaxos_tpu.obs.watch import (
     EV_LEADER_CHANGE,
     EV_NARROW_FALLBACK,
     EV_PHASE,
+    EV_RECOVERY,
+    EV_SNAPSHOT,
     EV_STORE_CORRUPT,
+    EV_TRUNCATE,
     EventJournal,
     burn_alarm,
     event_chrome_events,
 )
-from minpaxos_tpu.ops.kvstore import LIVE
+from minpaxos_tpu.ops.kvstore import LIVE, kv_insert_unique
 from minpaxos_tpu.ops.packed import join_i64, split_i64
 from minpaxos_tpu.ops.substeps import (
     SCAL_NAMES,
@@ -108,7 +112,7 @@ from minpaxos_tpu.runtime.transport import (
 from minpaxos_tpu.utils.clock import cputicks, monotonic_ns
 from minpaxos_tpu.utils.dlog import DLOG, dlog
 from minpaxos_tpu.utils.netutil import CONTROL_OFFSET
-from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch
+from minpaxos_tpu.wire.messages import MsgKind, Op, empty_batch, make_batch
 
 CONTROL = 3  # queue item source tag (transport uses 0..2)
 
@@ -147,6 +151,18 @@ def _packed_step(cfg, state, inbox, step_impl, k=1, narrow=0, off=0):
     state, (out_mats, exec_mats, scals) = scan_ticks(
         cfg, state, inbox, step_impl, k)
     return state, out_mats, exec_mats, scals
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _kv_install(kv, k_hi, k_lo, v, valid):
+    """Batch-insert snapshot pairs into the KV table (snapshot keys
+    are distinct by construction — the stable store sorts and the
+    sender's table held them uniquely). Module-level jit like
+    _packed_step: every replica in the process shares one compiled
+    variant per (chunk, capacity) shape, and donation updates the
+    table in place across the chunk loop."""
+    return kv_insert_unique(kv, k_hi, k_lo, v,
+                            delete=jnp.zeros_like(valid), valid=valid)
 
 
 @dataclass
@@ -263,7 +279,8 @@ class RuntimeFlags:
     # rows arrive and lingers up to coalesce_wait_us for more client
     # rows (stopping early at coalesce_rows) so concurrent sessions
     # share one dispatch. Admission control rides it: under exec-
-    # backlog or burn-rate overload (see _ingress_overloaded) client
+    # backlog, window-full, or burn-rate overload (_ingress_overloaded)
+    # client
     # PROPOSE frames beyond the pending bound are dropped at ingress
     # (clients retry) — bounded queueing instead of tail blowup. The
     # work_pending idle fast path is untouched (an idle replica still
@@ -308,6 +325,18 @@ class RuntimeFlags:
     # (the obs_smoke <=5 us/event guard pins it); -nowatch disables.
     watch: bool = True
     watch_ring: int = 1024
+    # paxdur snapshot + truncation policy (PR 20): checkpoint the
+    # applied KV state into the stable store (stable.py REC_SNAPSHOT)
+    # and truncate redo records below the PREVIOUS snapshot's frontier
+    # — two snapshots are retained so a corrupt newest one falls back
+    # to the older + a longer replay. The size trigger fires when the
+    # on-disk log grows snap_every_bytes past the last snapshot
+    # (-snap-every; 0 disables it); snap_interval_s adds a wall-clock
+    # trigger (0 = off). -nosnap turns the whole policy off: the log
+    # then grows unboundedly, exactly the pre-PR-20 behavior.
+    snapshots: bool = True
+    snap_every_bytes: int = 8 << 20
+    snap_interval_s: float = 0.0
     store_dir: str = "."
     # -cpuprofile: a cProfile.Profile the PROTOCOL THREAD enables on
     # start (cProfile is per-thread; enabling it on the main thread —
@@ -434,6 +463,14 @@ class ReplicaServer:
         # depth; an order of magnitude past it means execution lost
         # the race and new load must queue at the clients.
         self._admit_backlog_limit = max(8 * self.cfg.exec_batch, 256)
+        # commit-bound overload (paxdur follow-up): when the device
+        # window is within one exec batch of full, the kernel will
+        # window-reject any admitted PROPOSE anyway — each reject
+        # costs a device round trip plus a client retransmit, and on
+        # a commit-bound cluster (durable appends, snapshot pauses)
+        # that reject/retransmit loop is what melts the tail. Shed at
+        # the door instead: same counted drop, none of the wasted work.
+        self._admit_window_limit = self.cfg.window - self.cfg.exec_batch
         self._burn_hot = False
         self._burn_samples: deque[dict] = deque(maxlen=32)
         self._burn_last_s = 0.0
@@ -472,6 +509,31 @@ class ReplicaServer:
         # heal via peers, but the operator must see the disk went bad
         m.fn_gauge("store_corrupt_records",
                    lambda: self.store.corrupt_records)
+        # paxdur durability gauges: the on-disk bound truncation
+        # maintains, snapshot churn, and how stale the newest snapshot
+        # is (paxtop's SNAP column reads these; -1 = never snapshotted)
+        m.fn_gauge("store_log_bytes", self.store.log_bytes)
+        m.fn_gauge("snap_count", lambda: self.store.snapshots_taken)
+        m.fn_gauge("store_truncated_bytes",
+                   lambda: self.store.truncated_bytes)
+        m.fn_gauge("snap_age_s", self._snap_age_s)
+        # snapshot policy state (protocol thread only): next log size
+        # that triggers the size policy, last snapshot wall time, and
+        # the policy-check rate limiter (log_bytes is a stat() call —
+        # not per-tick material)
+        self._snap_goal_bytes = max(self.flags.snap_every_bytes, 1)
+        self._snap_last_s = time.monotonic()
+        self._snap_check_s = 0.0
+        self._snap_disabled = False
+        # snapshot catch-up: per-peer pacing of pushes (a transfer in
+        # flight must not be re-sent every tick) and the receive-side
+        # assembly buffers keyed by the announced snapshot frontier
+        self._snap_sent_s: dict[int, float] = {}
+        self._snap_seq = 0
+        self._snap_rx: dict[int, dict] = {}
+        # crash-restart fault injection: crash() emulates a process
+        # kill — no flush, no clean close, buffered store bytes lost
+        self._crashed = False
         self.inbox = batches.ColumnBuffer(self.cfg.inbox)
         # reply bookkeeping: (conn_id, cmd_id) -> reply kind to send
         self._pending: dict[tuple[int, int], MsgKind] = {}
@@ -594,6 +656,39 @@ class ReplicaServer:
         self.store.close()
         return joined
 
+    def crash(self) -> None:
+        """paxchaos process-kill emulation: die like a SIGKILLed
+        process, NOT like stop(). The store's buffered userspace bytes
+        are lost (StableStore.crash — the on-disk file keeps only what
+        already reached the kernel, possibly ending in a torn record),
+        sockets close without flushing, no deferred host phase
+        completes, and the control port goes dark so the master's
+        observe fan-out sees a dead replica. In-process threads cannot
+        be SIGKILLed, so this is the closest emulation the harness can
+        run: every durable artifact matches a real kill."""
+        self._crashed = True
+        self.store.crash()
+        self._stop.set()
+        # wake the protocol thread immediately (it may be parked on an
+        # idle-interval queue.get; the inbox queue is unbounded)
+        self.queue.put((CONTROL, 0, "crashed", None))
+        self.transport.stop()
+        if self._ctl_sock is not None:
+            try:
+                self._ctl_sock.close()
+            except OSError:
+                pass
+        if self._proto_thread is not None:
+            self._proto_thread.join(timeout=10.0)
+
+    def _snap_age_s(self) -> int:
+        """Seconds since the newest retained snapshot (-1 = none) —
+        wall-clock based so the age survives a restart."""
+        w = self.store.snap_wall_ns
+        if not w:
+            return -1
+        return max(0, int((time.time_ns() - w) // 1_000_000_000))
+
     # ---------------- recovery (stable-store replay) ----------------
 
     def _recover_from_store(self) -> None:
@@ -602,17 +697,30 @@ class ReplicaServer:
         (commits + executes + rebuilds the KV + slides the window),
         accepted tail as ACCEPT rows. The reference's
         getDataFromStableStore (bareminpaxos.go:122-161) rebuilt Go
-        structs; here recovery IS the protocol."""
+        structs; here recovery IS the protocol.
+
+        Snapshot-first (PR 20): a truncated store replays as the
+        newest CRC-valid snapshot's KV pairs installed directly into
+        the table + the redo SUFFIX above its frontier — the records
+        below it no longer exist on disk. A corrupt newest snapshot
+        already fell back inside StableStore._replay (base = the
+        previous snapshot, longer suffix), so this path never sees it."""
+        t_rec0 = time.perf_counter()
         frontier = self.store.committed_prefix()
         max_ballot = self.store.max_ballot()
         chunk = self.cfg.exec_batch
         own_max = -1  # highest recorded slot owned by me (mencius)
+        start = 0
+        if self.store.base >= 0 and self.protocol != "mencius":
+            self._install_snapshot_pairs(self.store.snapshot_pairs,
+                                         self.store.base)
+            start = self.store.base + 1
 
         def _own_slots_max(rec) -> int:
             mine = rec["inst"][rec["inst"] % self.cfg.n_replicas == self.me]
             return int(mine.max()) if len(mine) else -1
 
-        for lo in range(0, frontier + 1, chunk):
+        for lo in range(start, frontier + 1, chunk):
             rec = self.store.read_range(lo, min(lo + chunk, frontier + 1) - 1)
             own_max = max(own_max, _own_slots_max(rec))
             self._feed_records(rec, MsgKind.COMMIT)
@@ -647,8 +755,15 @@ class ReplicaServer:
             # EVENTS fan-out see it without scraping stderr
             self.journal.record(EV_STORE_CORRUPT, subject=self.me,
                                 value=self.store.corrupt_records)
+        # EV_RECOVERY: the replica rebuilt serving state from durable
+        # artifacts — value = the recovered frontier, aux = recovery
+        # wall ms (trend.py's recovery-cost row reads this)
+        self.journal.record(
+            EV_RECOVERY, subject=self.me, value=frontier,
+            aux=int((time.perf_counter() - t_rec0) * 1e3))
         dlog(f"replica {self.me}: recovered frontier={frontier} "
-             f"tail={len(tail)} ballot={max_ballot}")
+             f"base={self.store.base} tail={len(tail)} "
+             f"ballot={max_ballot}")
 
     def _feed_records(self, rec: np.ndarray, kind: MsgKind) -> None:
         if len(rec) == 0:
@@ -673,6 +788,55 @@ class ReplicaServer:
                        cmd_id=rec["cmd_id"][sl],
                        client_id=rec["client_id"][sl])
             self._device_tick(buf, persist=False, dispatch=False)
+
+    def _install_snapshot_pairs(self, pairs: np.ndarray,
+                                frontier: int) -> None:
+        """Fast-forward device state to a snapshot: install its live
+        KV pairs (chunked through the module-jitted insert, fixed
+        exec_batch shapes so no new compile per transfer size) and
+        move every protocol cursor to frontier+1. The log-window
+        arrays are re-zeroed — whatever they described is at/below the
+        snapshot's frontier, which the installed table already covers
+        — leaving exactly the state a replica that executed slots
+        0..frontier and slid its window would hold. Scalars are fresh
+        buffers (.copy()/computed) because the jitted step's donation
+        rejects one buffer appearing twice."""
+        chunk = max(self.cfg.exec_batch, 1)
+        k_hi, k_lo = split_i64(np.ascontiguousarray(pairs["key"]))
+        v_hi, v_lo = split_i64(np.ascontiguousarray(pairs["val"]))
+        kv = self.state.kv
+        for lo in range(0, len(pairs), chunk):
+            n = min(chunk, len(pairs) - lo)
+            ck_hi = np.zeros(chunk, np.int32)
+            ck_lo = np.zeros(chunk, np.int32)
+            cv = np.zeros((chunk, 2), np.int32)
+            valid = np.zeros(chunk, bool)
+            ck_hi[:n], ck_lo[:n] = k_hi[lo:lo + n], k_lo[lo:lo + n]
+            cv[:n, 0], cv[:n, 1] = v_hi[lo:lo + n], v_lo[lo:lo + n]
+            valid[:n] = True
+            kv = _kv_install(kv, ck_hi, ck_lo, cv, valid)
+        s = self.cfg.window
+        fj = jnp.int32(frontier)
+        self.state = self.state._replace(
+            ballot=jnp.full(s, NO_BALLOT, jnp.int32),
+            status=jnp.zeros(s, jnp.uint8),
+            op=jnp.zeros(s, jnp.uint8),
+            key_hi=jnp.zeros(s, jnp.int32),
+            key_lo=jnp.zeros(s, jnp.int32),
+            val_hi=jnp.zeros(s, jnp.int32),
+            val_lo=jnp.zeros(s, jnp.int32),
+            cmd_id=jnp.zeros(s, jnp.int32),
+            client_id=jnp.zeros(s, jnp.int32),
+            votes=jnp.zeros(s, jnp.uint16),
+            pvotes=jnp.zeros(s, jnp.uint16),
+            kv=kv,
+            window_base=fj + 1,
+            crt_inst=jnp.maximum(self.state.crt_inst, fj + 1),
+            committed_upto=fj.copy(),
+            executed_upto=fj.copy(),
+            rec_cursor=jnp.maximum(self.state.rec_cursor, fj + 1),
+            tenure_start=jnp.maximum(self.state.tenure_start, fj + 1),
+            gossip_upto=fj.copy())
 
     # ---------------- control plane (port + 1000) ----------------
 
@@ -894,12 +1058,21 @@ class ReplicaServer:
             # clean shutdown: complete any deferred host phases so the
             # last tick's replies/persistence aren't dropped with the
             # thread (a FATAL tick deliberately skips this — fail-stop
-            # must not keep serving)
-            self._flush_inflight()
+            # must not keep serving; a crash() drops them by design —
+            # a killed process never got to flush either)
+            if not self._crashed:
+                self._flush_inflight()
         except FatalReplicaError as e:
             # fail-stop: stop serving; the control plane keeps
             # answering pings with ok=False + the fatal reason
             print(f"FATAL: {e}", file=sys.stderr, flush=True)
+        except Exception:
+            # a crash() races the protocol thread mid-tick (closed
+            # sockets, swapped store fd): any exception it provokes is
+            # the kill itself, not a bug — die quietly like the killed
+            # process would. Everything else propagates.
+            if not self._crashed:
+                raise
         finally:
             if prof is not None:
                 prof.disable()
@@ -928,14 +1101,22 @@ class ReplicaServer:
         transport's READER threads, so it reads only the published
         snapshot and a plain bool (never ``self.state``). Overload =
         the paxmon exec backlog (committed-but-unexecuted) beyond the
-        boot-sized bound, or the replica-local paxwatch burn-rate
-        alarm (_update_burn). The coalescer turns a True verdict into
-        counted ingress drops once its own pending bound is exceeded —
-        bounded queueing at the clients instead of tail blowup."""
+        boot-sized bound, the device window nearly full (commits are
+        the bottleneck — a commit-bound cluster would window-reject
+        the rows downstream at full device-round-trip cost, so the
+        occupancy arm sheds them at the door before the reject/
+        retransmit loop amplifies the load), or the replica-local
+        paxwatch burn-rate alarm (_update_burn). The coalescer turns
+        a True verdict into counted ingress drops once its own
+        pending bound is exceeded — bounded queueing at the clients
+        instead of tail blowup."""
         snap = self.snapshot
         fr = int(snap.get("frontier", -1))
         ex = int(snap.get("executed", fr))
-        return fr - ex > self._admit_backlog_limit or self._burn_hot
+        wb = int(snap.get("window_base", 0))
+        return (fr - ex > self._admit_backlog_limit
+                or fr - wb >= self._admit_window_limit
+                or self._burn_hot)
 
     def _update_burn(self, now: float) -> None:
         """Feed the tick-wall histogram's cumulative bad/total pair
@@ -1075,8 +1256,73 @@ class ReplicaServer:
                 self._device_tick(self.inbox)
                 if int(self.snapshot.get("executed", -1)) <= prev_exec:
                     break  # no forward progress: stop chasing
+        self._maybe_snapshot()
         self._last_step = time.monotonic()
         self._c_ticks.inc(tick_inc)
+
+    def _maybe_snapshot(self) -> None:
+        """Snapshot + truncation policy (protocol thread, after the
+        tick's dispatches): checkpoint once the on-disk log grew
+        snap_every_bytes past the last snapshot, or snap_interval_s
+        elapsed with new execution. Rate-limited to 4 Hz — the size
+        probe is a stat() call, not per-tick material. Mencius is
+        gated off: its recovery replays the full log (ownership
+        cursors have no snapshot restore), so truncating under it
+        would orphan its own restart."""
+        fl = self.flags
+        if (not fl.snapshots or self._snap_disabled or self._crashed
+                or self.protocol == "mencius" or self.fatal is not None):
+            return
+        now = time.monotonic()
+        if now < self._snap_check_s:
+            return
+        self._snap_check_s = now + 0.25
+        exec_upto = int(self.snapshot.get("executed", -1))
+        if exec_upto < 0 or exec_upto <= self.store.snap_frontier:
+            return  # nothing newly applied to checkpoint
+        size_due = (fl.snap_every_bytes > 0
+                    and self.store.log_bytes() >= self._snap_goal_bytes)
+        time_due = (fl.snap_interval_s > 0
+                    and now - self._snap_last_s >= fl.snap_interval_s)
+        if size_due or time_due:
+            self._take_snapshot(exec_upto)
+
+    def _take_snapshot(self, exec_upto: int) -> None:
+        """Checkpoint the applied KV state at ``exec_upto`` into the
+        stable store and truncate the redo log (one atomic segment
+        swap, stable.py take_snapshot — two snapshots retained for the
+        corruption-fallback ladder). Runs between dispatches, so
+        ``self.state``'s buffers are alive and the published snapshot
+        corresponds exactly to them; deferred host phases complete
+        first so every record at/below exec_upto is in the store
+        before the rewrite."""
+        self._flush_inflight()
+        kv = self.state.kv
+        live = np.asarray(kv.slot) == LIVE
+        keys = join_i64(np.asarray(kv.key_hi)[live],
+                        np.asarray(kv.key_lo)[live])
+        v = np.asarray(kv.val)
+        vals = join_i64(v[live, 0], v[live, 1])
+        freed = self.store.take_snapshot(keys, vals, exec_upto,
+                                         wall_ns=time.time_ns())
+        if freed == -1:
+            # v1 store file (no CRC framing to protect a snapshot):
+            # the policy can never succeed on this file — stop probing
+            self._snap_disabled = True
+            return
+        lb = self.store.log_bytes()
+        # EV_SNAPSHOT: value = checkpointed frontier, aux = log bytes
+        # after; EV_TRUNCATE only when disk actually shrank (the first
+        # snapshot truncates nothing): value = bytes freed
+        self.journal.record(EV_SNAPSHOT, subject=self.me,
+                            value=exec_upto, aux=lb)
+        if freed > 0:
+            self.journal.record(EV_TRUNCATE, subject=self.me,
+                                value=freed, aux=lb)
+        self._snap_goal_bytes = lb + max(self.flags.snap_every_bytes, 1)
+        self._snap_last_s = time.monotonic()
+        dlog(f"replica {self.me}: snapshot@{exec_upto} "
+             f"({len(keys)} pairs, freed {freed} B, log {lb} B)")
 
     def _drain(self, timeout_s: float) -> bool:
         """Pull queued frames into the inbox buffer; returns whether a
@@ -1166,6 +1412,28 @@ class ReplicaServer:
                                 take["origin_wall_ns"].tolist()):
                             ring.record(tid, ST_ORIGIN, wall - my_off,
                                         wall - my_off, cmd)
+            elif kind == MsgKind.SNAP_META:
+                # snapshot catch-up announcement (host-path verb, like
+                # TRACE_CTX — never a device row): open an assembly
+                # buffer per announced frontier. Only transfers ahead
+                # of our own executed frontier are worth assembling.
+                for r in rows:
+                    fr = int(r["frontier"])
+                    if (fr > int(self.snapshot.get("executed", -1))
+                            and fr not in self._snap_rx):
+                        self._snap_rx[fr] = {"count": int(r["count"]),
+                                             "src": int(r["leader_id"]),
+                                             "rows": []}
+                self._snap_rx_install()  # count=0 installs immediately
+            elif kind == MsgKind.SNAP_ROWS:
+                # pairs for an announced transfer; the per-row frontier
+                # keys each row to ITS snapshot, so interleaved or
+                # re-sent transfers can't splice
+                for fr in np.unique(rows["frontier"]):
+                    st = self._snap_rx.get(int(fr))
+                    if st is not None:
+                        st["rows"].append(rows[rows["frontier"] == fr])
+                self._snap_rx_install()
             else:
                 if src_kind == FROM_PEER and kind in (
                         MsgKind.PREPARE, MsgKind.ACCEPT, MsgKind.COMMIT,
@@ -1258,14 +1526,24 @@ class ReplicaServer:
         lo = int(rows["inst"].min())
         if lo >= base:
             return  # in-window: the device answers
+        q = int(rows["leader_id"][0])
+        if not (0 <= q < self.cfg.n_replicas) or q == self.me:
+            return
+        sb = self.store.base
+        if sb >= 0 and lo <= sb:
+            # the sweep reaches below our truncation frontier: those
+            # redo records are gone — serve the snapshot (pull-path
+            # mirror of _host_catchup's push), then commits above it
+            self._send_snapshot(q)
+            lo = sb + 1
         hi = min(lo + self.cfg.catchup_rows - 1, self.store.committed_prefix())
         if hi < lo:
+            self.transport.flush_all()  # the snapshot frames, if any
             return
         frame = self._store_commit_frame(lo, hi, self.snapshot["frontier"])
-        q = int(rows["leader_id"][0])
-        if frame is not None and 0 <= q < self.cfg.n_replicas and q != self.me:
+        if frame is not None:
             self._send_or_redial(q, MsgKind.COMMIT, frame)
-            self.transport.flush_all()
+        self.transport.flush_all()
 
     def _become_leader(self) -> None:
         if self.protocol == "mencius":
@@ -1995,10 +2273,97 @@ class ReplicaServer:
             return
         base = snap["window_base"]
         fr = snap["frontier"]
+        sb = self.store.base
         for q in range(self.cfg.n_replicas):
             if q == self.me or pc[q] + 1 >= base:
+                continue
+            if sb >= 0 and pc[q] < sb:
+                # the peer needs slots BELOW our truncation frontier —
+                # those redo records no longer exist anywhere on this
+                # replica's disk. Ship the retained snapshot instead
+                # (SNAP_META + SNAP_ROWS, paced); the live suffix
+                # above it follows through this same path once the
+                # peer's reported frontier clears the snapshot.
+                self._send_snapshot(q)
                 continue
             frame = self._store_commit_frame(
                 int(pc[q]) + 1, min(int(pc[q]) + 256, base - 1), fr)
             if frame is not None:
                 self._send_or_redial(q, MsgKind.COMMIT, frame)
+
+    # minimum seconds between snapshot re-pushes to one peer: a
+    # transfer already in flight must not be re-sent every tick, and a
+    # peer that installed it advances its reported frontier well
+    # before this expires
+    _SNAP_RESEND_S = 2.0
+
+    def _send_snapshot(self, q: int) -> None:
+        """Push the newest retained snapshot to peer q: one SNAP_META
+        announcement, then its live pairs as SNAP_ROWS frames. Every
+        row repeats the snapshot frontier, so the receiver can never
+        splice two transfers; completeness is count-checked before
+        install (_snap_rx_install)."""
+        now = time.monotonic()
+        if now - self._snap_sent_s.get(q, -1e9) < self._SNAP_RESEND_S:
+            return
+        fr = self.store.snap_frontier
+        pairs = self.store.snapshot_pairs
+        if fr < 0:
+            return
+        self._snap_sent_s[q] = now
+        self._snap_seq += 1
+        meta = make_batch(MsgKind.SNAP_META, leader_id=self.me,
+                          frontier=fr, count=len(pairs),
+                          seq=self._snap_seq)
+        self._send_or_redial(q, MsgKind.SNAP_META, meta)
+        for lo in range(0, len(pairs), 4096):
+            ch = pairs[lo:lo + 4096]
+            rows = make_batch(MsgKind.SNAP_ROWS, frontier=fr,
+                              key=np.ascontiguousarray(ch["key"]),
+                              val=np.ascontiguousarray(ch["val"]))
+            self._send_or_redial(q, MsgKind.SNAP_ROWS, rows)
+        dlog(f"replica {self.me}: pushed snapshot@{fr} "
+             f"({len(pairs)} pairs) to replica {q}")
+
+    def _snap_rx_install(self) -> None:
+        """Install a COMPLETE received snapshot that is ahead of our
+        own executed frontier (protocol thread, called from _drain).
+        Install = the KV pairs into the device table + every cursor to
+        frontier+1 (_install_snapshot_pairs), then the snapshot into
+        OUR OWN stable store — a restart of this replica must replay
+        from it, not from slot 0 of a log it never held."""
+        for fr in sorted(self._snap_rx):
+            st = self._snap_rx[fr]
+            if sum(len(r) for r in st["rows"]) < st["count"]:
+                continue
+            del self._snap_rx[fr]
+            if fr <= int(self.snapshot.get("executed", -1)):
+                continue  # stale by the time it completed
+            t0 = time.perf_counter()
+            self._flush_inflight()
+            pairs = (np.concatenate(st["rows"]) if st["rows"]
+                     else empty_batch(MsgKind.SNAP_ROWS))
+            self._install_snapshot_pairs(pairs, fr)
+            self.store.take_snapshot(
+                np.ascontiguousarray(pairs["key"]),
+                np.ascontiguousarray(pairs["val"]), fr,
+                wall_ns=time.time_ns())
+            # publish before the next dispatch: fuse/narrow/idle
+            # decisions and the catch-up sender must see the new
+            # frontier, exactly as a readback would publish it
+            self.snapshot = dict(
+                self.snapshot, frontier=fr, executed=fr,
+                window_base=fr + 1,
+                crt_inst=max(int(self.snapshot.get("crt_inst", 0)),
+                             fr + 1),
+                work_pending=True)
+            self.journal.record(
+                EV_RECOVERY, subject=self.me, value=fr,
+                aux=int((time.perf_counter() - t0) * 1e3))
+            dlog(f"replica {self.me}: installed snapshot@{fr} "
+                 f"({len(pairs)} pairs) from replica {st['src']}")
+        # drop buffers that can no longer install (at/below our own
+        # frontier): a dead transfer must not pin its rows forever
+        done = int(self.snapshot.get("executed", -1))
+        for fr in [f for f in self._snap_rx if f <= done]:
+            del self._snap_rx[fr]

@@ -51,8 +51,24 @@ _FRONTIER = struct.Struct("<i")  # committed_upto
 
 REC_SLOTS = 1  # payload: u32 count + count*SLOT_DT
 REC_FRONTIER = 2  # payload: i32
+#: snapshot of the APPLIED KV state at an exec frontier: payload is
+#: [frontier i32][wall_ns i64][count u32] + count*SNAP_DT, CRC-framed
+#: like every v2 record — a flipped byte fails the record CRC and
+#: replay falls back to the previous retained snapshot (take_snapshot
+#: keeps two) + a longer redo replay. Record-type tags are append-only
+#: like wire opcodes (analysis/store_golden.py).
+REC_SNAPSHOT = 3
 _HDR = struct.Struct("<BI")  # record type, payload bytes
 _CRC = struct.Struct("<I")  # v2 framing: crc32(header || payload)
+
+#: one snapshot row: a live KV pair (key, value), sorted by key so the
+#: same applied state always snapshots byte-identically regardless of
+#: hash-table insertion order
+SNAP_DT = np.dtype([("key", "<i8"), ("val", "<i8")])
+_SNAP_HDR = struct.Struct("<iqI")  # frontier, wall_ns, pair count
+
+#: rows per REC_SLOTS record when take_snapshot rewrites the suffix
+_REWRITE_CHUNK = 8192
 
 #: per-file cap on individually warned corrupt records (the tally
 #: keeps counting; the terminal must not scroll a rotted disk forever)
@@ -71,6 +87,12 @@ class StableStore:
     def __init__(self, path: str, sync: bool = True):
         self.path = path
         self.sync = sync
+        # a stale .tmp is a segment swap that died before its
+        # os.replace: the original file is still authoritative
+        try:
+            os.unlink(path + ".tmp")
+        except OSError:
+            pass
         existed = os.path.exists(path) and os.path.getsize(path) > len(MAGIC)
         # mirror: log slots are DENSE integers, so the in-memory mirror
         # is a growable structured array + presence mask (34 B/slot,
@@ -94,6 +116,21 @@ class StableStore:
         # CRC-rejected records seen by _replay (surfaced as a paxmon
         # fn-gauge by the replica runtime)
         self.corrupt_records = 0
+        # snapshot state. ``base``: highest slot covered by the
+        # snapshot THIS replay started from (-1 = replayed the full
+        # redo log) — slot records at/below it are not in the mirror
+        # after a restart, so readers must treat [0, base] as
+        # snapshot-covered. A LIVE take_snapshot never rebases the
+        # mirror (disk is bounded, RAM stays complete), so base only
+        # moves at restart.
+        self.base = -1
+        self.snap_frontier = -1  # newest retained snapshot's frontier
+        self.snap_wall_ns = 0
+        self.snapshot_pairs = np.zeros(0, SNAP_DT)
+        self._snapshots: list[tuple[int, int, np.ndarray]] = []
+        self.snapshots_taken = 0  # this process, not lifetime
+        self.truncated_bytes = 0
+        self._crashed = False
         # whether this FILE carries v2 per-record CRCs (decided by its
         # magic on replay; new files are always v2)
         self.crc_framing = True
@@ -184,11 +221,7 @@ class StableStore:
         self._update_mirror(rec)
 
     def _write_record(self, rtype: int, payload: bytes) -> None:
-        hdr = _HDR.pack(rtype, len(payload))
-        self._f.write(hdr)
-        if self.crc_framing:
-            self._f.write(_CRC.pack(zlib.crc32(payload, zlib.crc32(hdr))))
-        self._f.write(payload)
+        self._write_record_to(self._f, rtype, payload)
 
     def append_frontier(self, committed_upto: int) -> None:
         if committed_upto <= self.frontier:
@@ -216,6 +249,141 @@ class StableStore:
         finally:
             self._f.close()
 
+    def crash(self) -> None:
+        """Emulate a process kill for fault injection: everything in
+        the userspace write buffer is LOST (like a SIGKILLed process's
+        unflushed stdio), the on-disk file keeps only what already
+        reached the kernel — possibly ending in a torn record. Further
+        appends/flushes land in /dev/null so the protocol thread dies
+        quietly instead of racing a closed fd."""
+        self._crashed = True
+        self.sync = False  # /dev/null rejects fsync on some kernels
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            # dup2 swaps the underlying fd: the buffered writer's
+            # pending bytes flush into /dev/null on close — gone, as
+            # they would be for a real kill
+            os.dup2(devnull, self._f.fileno())
+            os.close(devnull)
+        except OSError:
+            pass
+
+    def log_bytes(self) -> int:
+        """Current on-disk size — the bound truncation maintains
+        (paxmon fn-gauge; safe to call from the control thread)."""
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def snap_bytes(self) -> int:
+        """Bytes the retained snapshots occupy on disk (framing incl.)."""
+        per = _HDR.size + (_CRC.size if self.crc_framing else 0) + \
+            _SNAP_HDR.size
+        return sum(per + len(p) * SNAP_DT.itemsize
+                   for _, _, p in self._snapshots)
+
+    def take_snapshot(self, keys, vals, frontier: int,
+                      wall_ns: int = 0) -> int:
+        """Checkpoint the applied KV state at ``frontier`` and truncate
+        the redo log below the PREVIOUS snapshot's frontier, as one
+        atomic segment swap (write ``.tmp``, fsync, ``os.replace``).
+
+        Retains the last TWO snapshots: redo records in
+        (prev_frontier, new_frontier] stay in the file, so a corrupt
+        newest snapshot (bit rot, torn swap tail) falls back to the
+        previous one + a longer replay instead of diverging. The first
+        snapshot therefore truncates nothing. The in-RAM mirror is NOT
+        rebased — only disk is bounded; a live replica keeps serving
+        full-history catch-up from memory.
+
+        Returns bytes freed on disk (may be negative right after the
+        first snapshot), or -1 when refused (v1 file — no CRC framing
+        to protect the snapshot — or a crashed/invalid store).
+        """
+        if self._crashed or frontier < 0 or not self.crc_framing:
+            return -1
+        keys = np.asarray(keys, np.int64)
+        vals = np.asarray(vals, np.int64)
+        pairs = np.zeros(len(keys), SNAP_DT)
+        order = np.argsort(keys, kind="stable")
+        pairs["key"], pairs["val"] = keys[order], vals[order]
+        prev = self._snapshots[-1] if self._snapshots else None
+        keep_above = prev[0] if prev else -1
+        if self._contig < frontier:
+            # a snapshot AHEAD of the log we hold (wire catch-up
+            # installing onto a wiped or lagging replica, never a
+            # replica checkpointing its own applied state): slots
+            # [0, frontier] become snapshot-covered — rebase exactly
+            # as a restart replay would, so committed_prefix() and the
+            # catch-up readers stay truthful on the live store
+            self.base = max(self.base, frontier)
+            self._contig = frontier
+            start, end = frontier + 1, self._max_inst + 2
+            if start < len(self._have) and self._have[start]:
+                gap = np.nonzero(~self._have[start:end])[0]
+                self._contig = (start + int(gap[0]) - 1) if gap.size \
+                    else self._max_inst
+        self.frontier = max(self.frontier, frontier)
+        self._max_inst = max(self._max_inst, frontier)
+        # buffered appends must reach the file before its size is the
+        # "before" of the freed-bytes accounting (and before close()
+        # would flush them into the about-to-be-replaced file anyway)
+        self._f.flush()
+        old_size = self.log_bytes()
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as tf:
+            tf.write(MAGIC)
+            for f_s, w_ns, p in ([prev] if prev else []):
+                self._write_snapshot(tf, f_s, w_ns, p)
+            self._write_snapshot(tf, frontier, wall_ns, pairs)
+            hi = self._max_inst
+            rows = (self._mirror[: hi + 1][self._have[: hi + 1]]
+                    if hi >= 0 else np.zeros(0, SLOT_DT))
+            rows = rows[rows["inst"] > keep_above]
+            for i in range(0, len(rows), _REWRITE_CHUNK):
+                chunk = rows[i: i + _REWRITE_CHUNK]
+                self._write_record_to(tf, REC_SLOTS, chunk.tobytes())
+            if self.frontier >= 0:
+                self._write_record_to(tf, REC_FRONTIER,
+                                      _FRONTIER.pack(self.frontier))
+            tf.flush()
+            os.fsync(tf.fileno())
+        # the swap: old file stays authoritative until the replace
+        # lands (a crash between fsync and replace leaves a stale .tmp
+        # that __init__ discards)
+        self._f.close()
+        os.replace(tmp, self.path)
+        try:
+            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            os.fsync(dfd)
+            os.close(dfd)
+        except OSError:
+            pass
+        self._f = open(self.path, "ab")
+        self._snapshots = ([prev] if prev else []) + [
+            (frontier, wall_ns, pairs)]
+        self.snap_frontier = frontier
+        self.snap_wall_ns = wall_ns
+        self.snapshot_pairs = pairs
+        self.snapshots_taken += 1
+        freed = old_size - self.log_bytes()
+        self.truncated_bytes += max(0, freed)
+        return freed
+
+    def _write_snapshot(self, f, frontier: int, wall_ns: int,
+                        pairs: np.ndarray) -> None:
+        payload = _SNAP_HDR.pack(frontier, wall_ns, len(pairs)) + \
+            pairs.tobytes()
+        self._write_record_to(f, REC_SNAPSHOT, payload)
+
+    def _write_record_to(self, f, rtype: int, payload: bytes) -> None:
+        hdr = _HDR.pack(rtype, len(payload))
+        f.write(hdr)
+        if self.crc_framing:
+            f.write(_CRC.pack(zlib.crc32(payload, zlib.crc32(hdr))))
+        f.write(payload)
+
     # -- read --
 
     @staticmethod
@@ -231,7 +399,8 @@ class StableStore:
         while off + _HDR.size + _CRC.size <= end:
             rtype, plen = _HDR.unpack_from(data, off)
             body = off + _HDR.size + _CRC.size
-            if rtype in (REC_SLOTS, REC_FRONTIER) and body + plen <= end:
+            if (rtype in (REC_SLOTS, REC_FRONTIER, REC_SNAPSHOT)
+                    and body + plen <= end):
                 (crc,) = _CRC.unpack_from(data, off + _HDR.size)
                 want = zlib.crc32(data[body: body + plen],
                                   zlib.crc32(data[off: off + _HDR.size]))
@@ -253,6 +422,7 @@ class StableStore:
         self.crc_framing = crc_framing
         pos = len(MAGIC)
         self._parsed_end = pos  # last whole-record boundary reached
+        snaps: list[tuple[int, int, np.ndarray]] = []
         while pos + _HDR.size <= len(data):
             rtype, plen = _HDR.unpack_from(data, pos)
             body = pos + _HDR.size + (_CRC.size if crc_framing else 0)
@@ -305,11 +475,40 @@ class StableStore:
             elif rtype == REC_FRONTIER and plen == _FRONTIER.size:
                 (fr,) = _FRONTIER.unpack_from(data, body)
                 self.frontier = max(self.frontier, fr)
+            elif rtype == REC_SNAPSHOT and plen >= _SNAP_HDR.size:
+                f_s, w_ns, cnt = _SNAP_HDR.unpack_from(data, body)
+                if plen == _SNAP_HDR.size + cnt * SNAP_DT.itemsize:
+                    pairs = np.frombuffer(
+                        data, SNAP_DT, cnt, body + _SNAP_HDR.size).copy()
+                    snaps.append((f_s, w_ns, pairs))
             pos = body + plen
             self._parsed_end = pos
         if self.corrupt_records > _CORRUPT_WARN_CAP:
             print(f"{self.path}: {self.corrupt_records} corrupt records "
                   f"skipped in total", file=sys.stderr, flush=True)
+        if snaps:
+            # the newest CRC-valid snapshot is the replay base — a
+            # corrupt newest one never reached ``snaps`` (its record
+            # was skipped above), so the fallback to the previous
+            # snapshot + a longer redo replay happens here for free
+            snaps.sort(key=lambda s: s[0])
+            f_s, w_ns, pairs = snaps[-1]
+            self._snapshots = snaps[-2:]
+            self.base = f_s
+            self.snap_frontier = f_s
+            self.snap_wall_ns = w_ns
+            self.snapshot_pairs = pairs
+            self.frontier = max(self.frontier, f_s)
+            self._max_inst = max(self._max_inst, f_s)
+            if self._contig < f_s:
+                # slots [0, base] are snapshot-covered: restart the
+                # contiguity scan just above the base
+                self._contig = f_s
+                start, end = f_s + 1, self._max_inst + 2
+                if start < len(self._have) and self._have[start]:
+                    gap = np.nonzero(~self._have[start:end])[0]
+                    self._contig = (start + int(gap[0]) - 1) if gap.size \
+                        else self._max_inst
         covered = min(self._contig, self.frontier)
         self.committed = {i for i in self.committed if i > covered}
 

@@ -99,36 +99,69 @@ FULL_MANIFEST: dict = {
     "trace_pow2": 6,
     "seed": 23,
     "drain_timeout_s": 45.0,
+    # durable stores so the crash_restart phase has something to
+    # recover from; the snapshot threshold is sized so the multi-
+    # minute run still checkpoints + truncates several times (paxdur)
+    # WITHOUT the checkpoint pause dominating behavior: take_snapshot
+    # syncs the device KV and swaps the segment on the protocol
+    # thread, and at 64 KiB (~3 s cadence under this load) those
+    # pauses starved the cluster enough to flip the overload
+    # backpressure from the coalescer door to the device window —
+    # 256 KiB keeps the bounded-disk story while staying off the
+    # hot path's back
+    "durable": True,
     # size the ingress coalescer's row cap to this host's commit rate
     # (~600 slots/s on the 1-core CI box): the stock cap of inbox/2 =
     # 512 pending rows is ~1 s of queue — sized for a host an order of
     # magnitude faster — so the admission gate's queue-depth arm could
-    # never engage before the retransmit horizon. 96 rows is ~150 ms
-    # of queue; the gate still sheds ONLY while the burn/backlog
-    # detector reports overload, so this is deployment sizing, not a
-    # synthetic trip.
-    "runtime_flags": {"coalesce_rows": 96},
+    # never engage before the retransmit horizon. A shed needs BOTH
+    # the gate hot AND pending past the cap at put() time, and pending
+    # is bounded by arrival_rate x tick_wall (~2.7 rows/ms x 10-20 ms
+    # loaded ticks during the burst ≈ 30-55 rows): 32 rows ≈ a device
+    # batch puts the cap under the burst's per-tick build-up — so the
+    # door sheds DURING the burst, holding the excess at the clients
+    # under backoff instead of melting the server queue — while the
+    # 250 Hz steady phases build only ~5 rows/tick; the gate still
+    # sheds ONLY while the window/burn/backlog arms report overload,
+    # so this is deployment sizing, not a synthetic trip.
+    "runtime_flags": {"coalesce_rows": 32, "snap_every_bytes": 262144},
     "phases": [
         {"name": "warmup", "kind": "warmup", "profile": "uniform",
          "rate_hz": 300.0, "duration_s": 8.0},
         {"name": "hot_skew", "kind": "skew", "profile": "hot_zipf",
          "rate_hz": 500.0, "duration_s": 10.0,
          "diurnal_amp": 0.3, "diurnal_period_s": 10.0},
+        # x9 on the ~600 slots/s host queues ~8k excess commands —
+        # decisively past capacity (the gate + burn alarm must trip)
+        # yet small enough that the cooldown drains it before the
+        # partition phase even on a slow shared-host run; the durable
+        # cluster can't absorb the x14 the pre-paxdur record used
+        # without the drain racing host variance into the next phase
         {"name": "overload_burst", "kind": "overload",
          "profile": "write_storm", "rate_hz": 300.0, "duration_s": 12.0,
-         "burst_x": 14.0, "burst_t0_frac": 0.2, "burst_t1_frac": 0.45},
+         "burst_x": 9.0, "burst_t0_frac": 0.2, "burst_t1_frac": 0.45},
         # still the overload segment: the burst's shed commands keep
         # retransmitting (with backoff) until admitted, so the gate's
         # tail activity and any residual shedding must be accounted
-        # HERE, not bled into the partition phase's books
+        # HERE, not bled into the partition phase's books — sized so
+        # the burst's ~15k queued excess fully drains before the
+        # partition phase starts (the durable cluster's net drain is
+        # ~600 slots/s; 25 s at a 60 Hz trickle clears it with margin)
         {"name": "burst_cooldown", "kind": "overload",
-         "profile": "uniform", "rate_hz": 100.0, "duration_s": 15.0},
+         "profile": "uniform", "rate_hz": 60.0, "duration_s": 25.0},
         {"name": "partition_under_load", "kind": "partition",
          "profile": "mixed", "rate_hz": 250.0, "duration_s": 14.0,
          "chaos": {"op": "isolate", "target": 2,
                    "t0_frac": 0.15, "t1_frac": 0.70}},
         {"name": "heal", "kind": "heal", "profile": "uniform",
          "rate_hz": 250.0, "duration_s": 8.0},
+        # paxdur: kill a durable follower mid-load, restart it on the
+        # same store dir at t1_frac — it must recover from snapshot +
+        # redo suffix, catch up live, and the dead-replica stall alarm
+        # must raise inside the window, name it, and clear
+        {"name": "crash_restart", "kind": "crash_restart",
+         "profile": "uniform", "rate_hz": 250.0, "duration_s": 14.0,
+         "crash": {"target": 2, "t0_frac": 0.15, "t1_frac": 0.55}},
     ],
 }
 
@@ -278,6 +311,18 @@ def evaluate_criteria(scorecard: dict) -> dict:
       partition-kind phase fell inside the ground-truth fault window
       AND cleared after heal (vacuously false if no alarm raised at
       all during a partition phase);
+    * ``crash_detected_and_attributed`` — some frontier-stall alarm
+      raised during a crash_restart-kind phase fell inside the
+      ground-truth kill..restart window and NAMED the killed replica,
+      and every crash-phase stall alarm eventually cleared. Mirrors
+      the chaos campaign's ``_stall_verdict`` quantifiers exactly:
+      the edge-detected alarm legitimately flaps under load, and the
+      clear is NOT required to land after the restart mark — the
+      detector clears the moment the recovered replica's frontier
+      resumes advancing during catch-up, which is seconds BEFORE the
+      restart call (which waits out post-boot settling) stamps the
+      window closed (vacuously true with no crash phases — the smoke
+      manifest);
     * ``exactly_once`` — 0 lost across all shards, duplicates
       absorbed client-side.
     """
@@ -304,11 +349,29 @@ def evaluate_criteria(scorecard: dict) -> dict:
                             and a["cleared_after_heal"]
                             for a in part_alarms)
                     ) if part_names else True
+    # crash_restart phases: the kill target is ground truth from the
+    # manifest; the dead-replica stall alarm must land in the window,
+    # name the corpse, and clear once the restart catches up
+    crash_targets = {
+        p["name"]: int(p.get("crash", {}).get("target", -1))
+        for p in scorecard.get("manifest", {}).get("phases", [])
+        if p.get("kind") == "crash_restart" and p.get("crash")}
+    crash_alarms = [a for a in scorecard["alarms"]
+                    if a["phase"] in crash_targets
+                    and a["detector"] == "frontier_stall"]
+    crash_ok = (bool(crash_alarms)
+                and any(a["in_fault_window"]
+                        and a["subject"] == crash_targets[a["phase"]]
+                        for a in crash_alarms)
+                and all(a["t_cleared"] is not None
+                        for a in crash_alarms)
+                ) if crash_targets else True
     eo = scorecard["exactly_once"]
     exactly_once = eo["lost"] == 0 and eo["acked_unique"] > 0
     crit = {"admission_organic": admission_organic,
             "overload_alarm_journaled": overload_alarm,
             "partition_detected_in_window": partition_ok,
+            "crash_detected_and_attributed": crash_ok,
             "exactly_once": exactly_once}
     crit["ok"] = all(crit.values())
     return crit
@@ -334,6 +397,7 @@ def run_scenario(manifest: dict, log=print) -> dict:
     log(f"paxsoak[{manifest['name']}]: booting {n}-replica cluster")
     cluster = ChaosCluster(n=n, q1=int(manifest.get("q1", 0)),
                            q2=int(manifest.get("q2", 0)),
+                           durable=bool(manifest.get("durable", False)),
                            flags=manifest.get("runtime_flags"))
     swarm = None
     watcher = None
@@ -396,14 +460,44 @@ def run_scenario(manifest: dict, log=print) -> dict:
                     raise ValueError(
                         f"chaos window [{t_in}, {t_out}] outside "
                         f"phase of {d}s")
-                timers = [threading.Timer(t_in, install),
-                          threading.Timer(t_out, clear)]
-                for t in timers:
-                    t.start()
+                timers += [threading.Timer(t_in, install),
+                           threading.Timer(t_out, clear)]
+            if ph.get("crash"):
+                # paxdur process fault: kill the target replica at
+                # t0_frac, restart it (same ports, same store dir) at
+                # t1_frac — a ground-truth fault window the alarm
+                # classification joins against, like a chaos window
+                spec = ph["crash"]
+                rid = int(spec["target"])
+                window = {"phase": ph["name"], "crash": {"rid": rid},
+                          "t_install": None, "t_clear": None,
+                          "grace_s": 3.0}
+                fault_windows.append(window)
+
+                def kill(w=window, r=rid):
+                    w["t_install"] = time.time()
+                    cluster.kill(r)
+
+                def restart(w=window, r=rid):
+                    cluster.restart(r)
+                    w["t_clear"] = time.time()
+
+                d = arrival.duration_s
+                t_in = float(spec.get("t0_frac", 0.15)) * d
+                t_out = float(spec.get("t1_frac", 0.55)) * d
+                if not 0 <= t_in < t_out <= d:
+                    raise ValueError(
+                        f"crash window [{t_in}, {t_out}] outside "
+                        f"phase of {d}s")
+                timers += [threading.Timer(t_in, kill),
+                           threading.Timer(t_out, restart)]
+            for t in timers:
+                t.start()
             log(f"paxsoak: phase {i} '{ph['name']}' ({kind}) — "
                 f"{ph['rate_hz']:.0f} Hz x {arrival.duration_s:.0f}s"
                 + (f" x{ph['burst_x']} burst" if ph.get("burst_x") else "")
-                + (" + chaos" if ph.get("chaos") else ""))
+                + (" + chaos" if ph.get("chaos") else "")
+                + (" + crash" if ph.get("crash") else ""))
             res = swarm.run_phase(ph.get("profile", "uniform"),
                                   arrival, seed + i)
             for t in timers:

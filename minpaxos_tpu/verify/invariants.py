@@ -28,6 +28,10 @@ Invariants:
   no-op fill (gap heal / Mencius skip). A log cannot invent writes.
 * **Frontier monotonicity** — a replica's committed frontier, sampled
   in time order, never decreases.
+* **Snapshot agreement** — a durable snapshot's (key, val) pairs
+  byte-equal a record-complete peer's replay of the same prefix: a
+  replica that recovered through a snapshot converged to the same
+  state it would have reached replaying every record.
 * **Per-key linearizable history** — replay the committed log in slot
   order; every acked GET's reply matches the replayed value of its
   key at some committed occurrence, and every acked command appears
@@ -60,6 +64,7 @@ class CheckReport:
     compared_slots: int = 0
     replayed_slots: int = 0
     checked_gets: int = 0
+    snapshot_pairs_checked: int = 0
     frontiers: dict[int, int] = field(default_factory=dict)
 
     def add(self, msg: str) -> None:
@@ -71,6 +76,7 @@ class CheckReport:
                 "compared_slots": self.compared_slots,
                 "replayed_slots": self.replayed_slots,
                 "checked_gets": self.checked_gets,
+                "snapshot_pairs_checked": self.snapshot_pairs_checked,
                 "frontiers": {str(k): v for k, v in self.frontiers.items()}}
 
 
@@ -88,31 +94,43 @@ def make_records(insts, ops, keys, vals, cmd_ids, client_ids) -> np.ndarray:
 
 def check_slot_agreement(records: dict[int, np.ndarray],
                          frontiers: dict[int, int],
-                         report: CheckReport) -> None:
+                         report: CheckReport,
+                         bases: dict[int, int] | None = None) -> None:
     """Pairwise byte-level cross-check of committed prefixes.
 
     ``records[rid]``: slot records for every slot replica ``rid`` holds
     committed at inst <= ``frontiers[rid]``; prefixes are expected to be
     record-complete (a missing slot below both frontiers is itself a
     violation — a committed slot a replica cannot produce is a hole).
+
+    ``bases[rid]`` (optional, default -1): slots <= base are
+    snapshot-covered on that replica — the records were truncated away
+    behind a durable snapshot, so record agreement for a pair starts
+    ABOVE the higher of the two bases (the snapshot itself is held to
+    a record-complete peer by :func:`check_snapshot_agreement`).
     """
     ids = sorted(records)
+    bases = bases or {}
     report.frontiers.update({r: int(frontiers[r]) for r in ids})
     for i, a in enumerate(ids):
         for b in ids[i + 1:]:
             lo_pref = min(frontiers[a], frontiers[b])
             if lo_pref < 0:
                 continue
-            ra = records[a][records[a]["inst"] <= lo_pref]
-            rb = records[b][records[b]["inst"] <= lo_pref]
+            base_hi = max(bases.get(a, -1), bases.get(b, -1))
+            ra = records[a][(records[a]["inst"] <= lo_pref)
+                            & (records[a]["inst"] > base_hi)]
+            rb = records[b][(records[b]["inst"] <= lo_pref)
+                            & (records[b]["inst"] > base_hi)]
             # align by inst: both prefixes are record-complete by
             # definition of committed_prefix, so the insts must match
             common, ia, ib = np.intersect1d(ra["inst"], rb["inst"],
                                             return_indices=True)
-            if len(common) != lo_pref + 1:
+            if len(common) != lo_pref - base_hi:
                 report.add(
                     f"replicas {a}/{b}: committed prefixes claim "
-                    f"{lo_pref + 1} slots but only {len(common)} "
+                    f"{lo_pref - base_hi} comparable slots (above "
+                    f"snapshot base {base_hi}) but only {len(common)} "
                     f"records are present on both")
             for f in VALUE_FIELDS:
                 bad = np.nonzero(ra[f][ia] != rb[f][ib])[0]
@@ -130,11 +148,63 @@ def check_slot_agreement(records: dict[int, np.ndarray],
 def check_log_agreement(stores: dict[int, "StableStore"],
                         report: CheckReport) -> None:
     """Agreement over durable-log mirrors (the chaos prover's path):
-    reduce each store to slot records, then run the shared predicate."""
+    reduce each store to slot records, then run the shared predicate.
+    Snapshot-rebased stores (base >= 0 after a crash-restart replay)
+    are compared above their base; the snapshot itself is verified by
+    :func:`check_snapshot_agreement`."""
     frontiers = {rid: stores[rid].committed_prefix() for rid in stores}
-    records = {rid: stores[rid].read_range(0, frontiers[rid])
+    bases = {rid: int(getattr(stores[rid], "base", -1))
+             for rid in stores}
+    records = {rid: stores[rid].read_range(max(0, bases[rid] + 1),
+                                           frontiers[rid])
                for rid in stores}
-    check_slot_agreement(records, frontiers, report)
+    check_slot_agreement(records, frontiers, report, bases=bases)
+
+
+def check_snapshot_agreement(stores: dict[int, "StableStore"],
+                             report: CheckReport) -> None:
+    """Every durable snapshot must byte-equal a record-complete peer's
+    replay of the same prefix: for each store whose newest snapshot
+    covers [0, snap_frontier], replay slots 0..snap_frontier from a
+    peer that still HOLDS those records (base < 0) into a KV dict and
+    compare against the snapshot's (key, val) pairs. This is the
+    byte-identical-convergence evidence for a restarted replica whose
+    low slots exist only as snapshot state."""
+    full = [r for r in sorted(stores)
+            if int(getattr(stores[r], "base", -1)) < 0]
+    for rid in sorted(stores):
+        st = stores[rid]
+        sf = int(getattr(st, "snap_frontier", -1))
+        if sf < 0:
+            continue
+        donors = [p for p in full
+                  if p != rid and stores[p].committed_prefix() >= sf]
+        if not donors:
+            # nothing record-complete reaches the snapshot frontier:
+            # not a safety violation (agreement above base still ran),
+            # just nothing to hold the snapshot against
+            continue
+        rec = stores[donors[0]].read_range(0, sf)
+        kv: dict[int, int] = {}
+        for j in range(len(rec)):
+            if (int(rec["client_id"][j]) < 0
+                    or int(rec["op"][j]) != int(Op.PUT)):
+                continue
+            kv[int(rec["key"][j])] = int(rec["val"][j])
+        pairs = st.snapshot_pairs
+        got = {int(k): int(v)
+               for k, v in zip(pairs["key"], pairs["val"])}
+        if got != kv:
+            extra = sorted(set(got) - set(kv))[:3]
+            missing = sorted(set(kv) - set(got))[:3]
+            diff = sorted(k for k in set(kv) & set(got)
+                          if kv[k] != got[k])[:3]
+            report.add(
+                f"SNAPSHOT DIVERGENCE replica {rid} snap_frontier {sf} "
+                f"vs replica {donors[0]} replay: {len(got)} snapshot "
+                f"pairs vs {len(kv)} replayed (extra keys {extra}, "
+                f"missing {missing}, differing {diff})")
+        report.snapshot_pairs_checked += len(kv)
 
 
 # ------------------------------------------------------------ validity
@@ -211,7 +281,14 @@ def check_linearizable(store: "StableStore", replies: dict[int, dict],
     prefix = store.committed_prefix()
     if prefix < 0:
         return
-    rec = store.read_range(0, prefix)
+    # a snapshot-rebased store (base >= 0) only holds records above
+    # base: replay the suffix, skip GETs whose prior state is
+    # snapshot-covered, and waive the lost-write check (acked commands
+    # below base are invisible by design). check_cluster prefers a
+    # record-complete replica, so this weakening only engages when NO
+    # replica still holds the full log.
+    base = int(getattr(store, "base", -1))
+    rec = store.read_range(base + 1 if base >= 0 else 0, prefix)
     report.replayed_slots += len(rec)
     acked = {int(c) for c in replies}
     seen: set[int] = set()
@@ -236,6 +313,8 @@ def check_linearizable(store: "StableStore", replies: dict[int, dict],
         if op == int(Op.PUT):
             kv[key] = int(rec["val"][j])
         elif op == int(Op.GET) and cmd in acked and cmd not in get_ok:
+            if base >= 0 and key not in kv:
+                continue  # prior value snapshot-covered: unverifiable
             want = kv.get(key, 0)
             got = replies[cmd].get("val")
             if got == want:
@@ -247,6 +326,8 @@ def check_linearizable(store: "StableStore", replies: dict[int, dict],
         report.add(f"GET cmd {cmd}: reply value {got} matches no "
                    f"committed occurrence (last replayed value {want})")
     report.checked_gets += len(get_ok) + len(get_bad)
+    if base >= 0:
+        return  # commands below base are snapshot-covered
     lost = sorted(acked - seen)
     if lost:
         report.add(f"{len(lost)} acked command(s) absent from the "
@@ -265,6 +346,7 @@ def check_cluster(stores: dict[int, "StableStore"],
     predicates piecemeal on model states instead)."""
     report = CheckReport()
     check_log_agreement(stores, report)
+    check_snapshot_agreement(stores, report)
     if frontier_samples:
         check_frontier_monotonic(frontier_samples, report)
     if workload is not None:
@@ -274,11 +356,19 @@ def check_cluster(stores: dict[int, "StableStore"],
         # write (cmd_id outside the workload) must fail the chaos
         # prover exactly like it fails the bounded exploration
         for rid in sorted(stores):
-            rec = stores[rid].read_range(0, stores[rid].committed_prefix())
+            lo = max(0, int(getattr(stores[rid], "base", -1)) + 1)
+            rec = stores[rid].read_range(lo,
+                                         stores[rid].committed_prefix())
             check_validity(rec, ops, keys, vals, report,
                            who=f"replica {rid}")
         if replies is not None:
-            best = max(stores, key=lambda r: stores[r].committed_prefix())
+            # prefer a record-complete replica (base -1 beats any
+            # rebased store at equal prefix): the strong form of the
+            # replay — every acked command held to the full log
+            best = max(stores,
+                       key=lambda r: (stores[r].committed_prefix(),
+                                      -int(getattr(stores[r], "base",
+                                                   -1))))
             check_linearizable(stores[best], replies, ops, keys, vals,
                                report)
     return report

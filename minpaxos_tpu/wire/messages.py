@@ -89,6 +89,15 @@ class MsgKind(enum.IntEnum):
     # deriving the trace id from the command id alone.
     TRACE_CTX = 32
 
+    # snapshot-based peer catch-up (PR 20, no reference counterpart —
+    # the reference replays blank-state replicas from the leader's
+    # full log, which a truncated store no longer holds): SNAP_META
+    # announces one snapshot transfer (frontier + row count), SNAP_ROWS
+    # carries its live KV pairs. Host-path verbs like TRACE_CTX: no
+    # kernel branch consumes them.
+    SNAP_META = 33
+    SNAP_ROWS = 34
+
     # connection handshake pseudo-kinds (reference genericsmrproto.go:16-17)
     HANDSHAKE_CLIENT = 120
     HANDSHAKE_PEER = 121
@@ -185,6 +194,19 @@ SCHEMAS: dict[MsgKind, np.dtype] = {
     MsgKind.TRACE_CTX: np.dtype(
         [("cmd_id", "<i4"), ("trace_id", "<i8"),
          ("origin_wall_ns", "<i8")]),
+    # snapshot catch-up announcement: the sender's snapshot frontier,
+    # how many SNAP_ROWS rows follow for it, and the sender id. One
+    # row per transfer; the receiver assembles rows keyed by
+    # (frontier, count) and installs only a COMPLETE set that is ahead
+    # of its own committed frontier.
+    MsgKind.SNAP_META: np.dtype(
+        [("leader_id", "i1"), ("frontier", "<i4"), ("count", "<i4"),
+         ("seq", "<i4")]),
+    # one live KV pair of the snapshot at ``frontier`` (the frontier
+    # repeats per row so a reordered/interleaved stream can't splice
+    # rows from two different snapshots into one install)
+    MsgKind.SNAP_ROWS: np.dtype(
+        [("frontier", "<i4"), ("key", "<i8"), ("val", "<i8")]),
 }
 
 

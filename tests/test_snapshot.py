@@ -1,0 +1,490 @@
+"""Durable snapshots, log truncation, and recovery (PR 20 paxdur).
+
+The stable store's snapshot contract (runtime/stable.py
+``take_snapshot`` / ``_replay``) has four load-bearing claims these
+tests pin:
+
+* **Equivalence** — replaying the newest snapshot + the redo suffix
+  above it reconstructs BYTE-IDENTICAL applied state to replaying the
+  full log it truncated (the ISSUE's pinned property);
+* **Bounded disk** — the second snapshot onward actually shrinks the
+  file, and what was truncated is exactly the redo records at/below
+  the previous snapshot's frontier;
+* **Fallback ladder** — a corrupt newest snapshot (bit rot, torn
+  segment-swap tail) falls back to the PREVIOUS retained snapshot plus
+  a longer replay, never to garbage and never to data loss that peers
+  cannot re-send;
+* **Kill-point safety** — a crash at ANY byte boundary during the
+  post-swap append stream leaves a file that reopens without error
+  into a self-consistent prefix, and converges back once peers re-send
+  the lost records.
+
+The replica-level tests drive threadless servers (the
+tests/test_pipeline.py harness pattern: the test owns drain/tick, so
+runs are deterministic) through the same trace with and without
+snapshots, and through the SNAP_META/SNAP_ROWS wire install path.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from minpaxos_tpu.runtime.stable import (
+    MAGIC,
+    MAGIC_V1,
+    REC_FRONTIER,
+    REC_SLOTS,
+    REC_SNAPSHOT,
+    SNAP_DT,
+    StableStore,
+)
+
+# ----------------------------------------------------- store helpers
+
+
+def _append(s: StableStore, lo: int, hi: int, key_mod: int = 8) -> None:
+    """Committed PUT slots for inst in [lo, hi) — key = inst % key_mod,
+    val = inst * 100 + 7, so the applied state is derivable from the
+    slot range alone."""
+    n = hi - lo
+    inst = np.arange(lo, hi)
+    s.append_slots(inst, np.full(n, 16), np.full(n, 4), np.ones(n),
+                   inst % key_mod, inst * 100 + 7, inst, np.zeros(n))
+
+
+def _applied(pairs: np.ndarray, rec: np.ndarray,
+             upto: int) -> np.ndarray:
+    """Reference replay: snapshot pairs + redo records in inst order,
+    PUTs only, up to ``upto`` — returned as key-sorted SNAP_DT rows so
+    equality is a tobytes() comparison."""
+    kv = {int(k): int(v) for k, v in zip(pairs["key"], pairs["val"])}
+    rec = rec[np.argsort(rec["inst"], kind="stable")]
+    for r in rec:
+        if int(r["inst"]) > upto:
+            break
+        if int(r["op"]) == 1 and int(r["client_id"]) >= 0:
+            kv[int(r["key"])] = int(r["val"])
+    out = np.zeros(len(kv), SNAP_DT)
+    for i, k in enumerate(sorted(kv)):
+        out["key"][i], out["val"][i] = k, kv[k]
+    return out
+
+
+def _store_applied(s: StableStore) -> np.ndarray:
+    base = s.base
+    pairs = s.snapshot_pairs if base >= 0 else np.zeros(0, SNAP_DT)
+    rec = s.read_range(base + 1, s.committed_prefix())
+    return _applied(pairs, rec, s.committed_prefix())
+
+
+def _records(path) -> list[tuple[int, int, int, int]]:
+    """Parse the v2 file framing: (offset, rtype, payload_len,
+    payload_offset) per record — so tests can target a specific record
+    for corruption without hardcoding byte offsets."""
+    data = open(path, "rb").read()
+    assert data[:8] == MAGIC
+    out, pos = [], 8
+    while pos + 5 <= len(data):
+        rtype, plen = struct.unpack_from("<BI", data, pos)
+        body = pos + 5 + 4
+        if body + plen > len(data):
+            break
+        out.append((pos, rtype, plen, body))
+        pos = body + plen
+    return out
+
+
+# ------------------------------------------- equivalence + bounding
+
+
+def test_snapshot_plus_suffix_replay_byte_equals_full_log(tmp_path):
+    """The pinned property: a store that snapshotted (twice — so the
+    log was actually truncated) replays to byte-identical applied
+    state and committed prefix as a full-log twin fed the same
+    appends."""
+    full, snap = tmp_path / "full", tmp_path / "snap"
+    a = StableStore(str(full), sync=True)
+    b = StableStore(str(snap), sync=True)
+    for lo in (0, 40, 80):
+        _append(a, lo, lo + 40)
+        _append(b, lo, lo + 40)
+        a.append_frontier(lo + 39)
+        b.append_frontier(lo + 39)
+        st = _store_applied(b)
+        keys, vals = st["key"], st["val"]
+        assert b.take_snapshot(keys, vals, lo + 39, wall_ns=1) != -1
+    assert b.snapshots_taken == 3 and b.truncated_bytes > 0
+    a.close()
+    b.close()
+    # disk is bounded: the snapshotted file dropped the redo records
+    # at/below the PREVIOUS snapshot's frontier
+    assert os.path.getsize(snap) < os.path.getsize(full)
+    ra, rb = StableStore(str(full)), StableStore(str(snap))
+    assert ra.base == -1 and rb.base == 119  # newest retained snapshot
+    assert rb.snap_frontier == 119  # taken at the final frontier
+    assert ra.committed_prefix() == rb.committed_prefix() == 119
+    assert _store_applied(ra).tobytes() == _store_applied(rb).tobytes()
+    # the suffix above the replay base is identical record-for-record
+    np.testing.assert_array_equal(ra.read_range(80, 119),
+                                  rb.read_range(80, 119))
+    ra.close()
+    rb.close()
+
+
+def test_first_snapshot_truncates_nothing_second_truncates(tmp_path):
+    """Two snapshots are retained for the fallback ladder, so the
+    first one cannot free disk; the second frees exactly the records
+    at/below the first's frontier."""
+    path = tmp_path / "store"
+    s = StableStore(str(path), sync=True)
+    _append(s, 0, 64)
+    s.append_frontier(63)
+    st = _store_applied(s)
+    s.take_snapshot(st["key"], st["val"], 63, wall_ns=1)
+    assert s.truncated_bytes == 0  # everything still retained
+    _append(s, 64, 128)
+    s.append_frontier(127)
+    st = _store_applied(s)
+    freed = s.take_snapshot(st["key"], st["val"], 127, wall_ns=2)
+    assert freed > 0 and s.truncated_bytes == freed
+    # records at/below the previous snapshot's frontier are gone from
+    # disk but the in-RAM mirror still serves them (live catch-up)
+    assert len(s.read_range(0, 63)) == 64
+    s.close()
+    r = StableStore(str(path))
+    assert len(r.read_range(0, 63)) == 0
+    assert len(r.read_range(64, 127)) == 64
+    assert r.committed_prefix() == 127
+    r.close()
+
+
+# --------------------------------------------------- fallback ladder
+
+
+def test_bitflipped_newest_snapshot_falls_back_to_previous(tmp_path):
+    """A flipped byte in the newest snapshot's payload fails its CRC;
+    replay must land on the PREVIOUS snapshot with the (longer) redo
+    suffix — same applied state, one corrupt record counted."""
+    path = tmp_path / "store"
+    s = StableStore(str(path), sync=True)
+    for lo in (0, 32, 64):
+        _append(s, lo, lo + 32)
+        s.append_frontier(lo + 31)
+        st = _store_applied(s)
+        s.take_snapshot(st["key"], st["val"], lo + 31, wall_ns=1)
+    want = _store_applied(s).tobytes()
+    s.close()
+    snaps = [r for r in _records(path) if r[1] == REC_SNAPSHOT]
+    assert len(snaps) == 2  # two retained: frontier 63 and 95
+    raw = bytearray(path.read_bytes())
+    raw[snaps[-1][3] + 20] ^= 0x01  # newest snapshot, inside a pair
+    path.write_bytes(bytes(raw))
+    r = StableStore(str(path))
+    assert r.corrupt_records == 1
+    assert r.snap_frontier == 63 and r.base == 63  # the previous one
+    # the redo suffix (63, 95] survived the fallback: prefix + state
+    # fully recover without any peer help
+    assert r.committed_prefix() == 95
+    assert _store_applied(r).tobytes() == want
+    r.close()
+
+
+def test_torn_snapshot_tail_recovers_previous_and_heals(tmp_path):
+    """Truncating mid-newest-snapshot (a tear across the segment-swap
+    tail) must reopen on the previous snapshot; the lost suffix then
+    converges back through ordinary re-appends (peer re-sends)."""
+    path = tmp_path / "store"
+    s = StableStore(str(path), sync=True)
+    for lo in (0, 32):
+        _append(s, lo, lo + 32)
+        s.append_frontier(lo + 31)
+        st = _store_applied(s)
+        s.take_snapshot(st["key"], st["val"], lo + 31, wall_ns=1)
+    want = _store_applied(s).tobytes()
+    s.close()
+    snaps = [r for r in _records(path) if r[1] == REC_SNAPSHOT]
+    with open(path, "r+b") as f:  # cut INTO the newest snapshot record
+        f.truncate(snaps[-1][3] + snaps[-1][2] // 2)
+    r = StableStore(str(path))
+    assert r.snap_frontier == 31 and r.base == 31
+    assert r.committed_prefix() == 31  # the suffix was torn off too
+    _append(r, 32, 64)  # peers re-send the lost records
+    r.append_frontier(63)
+    r.flush()
+    assert r.committed_prefix() == 63
+    assert _store_applied(r).tobytes() == want
+    r.close()
+    r2 = StableStore(str(path))  # and the healed file replays clean
+    assert r2.committed_prefix() == 63
+    assert _store_applied(r2).tobytes() == want
+    r2.close()
+
+
+def test_stale_tmp_from_died_swap_is_discarded(tmp_path):
+    """A crash between the segment fsync and the os.replace leaves a
+    complete-looking .tmp; reopen must discard it — the original file
+    is still the authoritative one."""
+    path = tmp_path / "store"
+    s = StableStore(str(path), sync=True)
+    _append(s, 0, 16)
+    s.append_frontier(15)
+    s.flush()
+    want = _store_applied(s).tobytes()
+    s.close()
+    (tmp_path / "store.tmp").write_bytes(MAGIC + b"\x03\xff\xff\xff\xff")
+    r = StableStore(str(path))
+    assert not os.path.exists(tmp_path / "store.tmp")
+    assert r.committed_prefix() == 15
+    assert _store_applied(r).tobytes() == want
+    r.close()
+
+
+def test_truncation_kill_point_sweep(tmp_path):
+    """Crash-at-every-boundary: for every truncation point in a
+    snapshotted-then-appended file, reopen must (a) not raise, (b)
+    recover a self-consistent prefix whose every record matches the
+    original, and (c) converge back to the full state once the
+    original appends are replayed on top."""
+    path = tmp_path / "store"
+    s = StableStore(str(path), sync=True)
+    for lo in (0, 16):
+        _append(s, lo, lo + 16)
+        s.append_frontier(lo + 15)
+        st = _store_applied(s)
+        s.take_snapshot(st["key"], st["val"], lo + 15, wall_ns=1)
+    _append(s, 32, 48)  # post-swap append stream (the torn region)
+    s.append_frontier(47)
+    s.flush()
+    want = _store_applied(s).tobytes()
+    full = s.read_range(0, 47)
+    s.close()
+    size = os.path.getsize(path)
+    work = tmp_path / "cut"
+    data = open(path, "rb").read()
+    for cut in list(range(len(MAGIC), size, 7)) + [size - 1]:
+        work.write_bytes(data[:cut])
+        r = StableStore(str(work))  # must never raise
+        # recovered records are a subset byte-equal to the originals
+        got = r.read_range(0, 47)
+        by_inst = {int(x["inst"]): x for x in full}
+        for x in got:
+            assert x == by_inst[int(x["inst"])], cut
+        assert r.committed_prefix() <= 47
+        assert r.snap_frontier in (-1, 15, 31), cut
+        # convergence: replay every original record + frontier on top
+        _append(r, 0, 48)
+        r.append_frontier(47)
+        assert r.committed_prefix() == 47, cut
+        assert _store_applied(r).tobytes() == want, cut
+        r.close()
+
+
+# ------------------------------------------------------ v1/v2 compat
+
+
+def test_v1_store_refuses_snapshot_and_stays_v1(tmp_path):
+    """Pre-CRC (MPXL0001) files have no integrity framing to protect a
+    snapshot record, so take_snapshot must refuse (-1) and leave the
+    file byte-identical; replay and v1 appends keep working."""
+    path = tmp_path / "store"
+    from minpaxos_tpu.runtime.stable import SLOT_DT
+    rec = np.zeros(4, SLOT_DT)
+    rec["inst"] = np.arange(4)
+    rec["ballot"], rec["status"], rec["op"] = 16, 4, 1
+    rec["key"], rec["val"] = np.arange(4) % 8, np.arange(4) * 100 + 7
+    payload = rec.tobytes()
+    with open(path, "wb") as f:
+        f.write(MAGIC_V1)
+        f.write(struct.pack("<BI", REC_SLOTS, len(payload)) + payload)
+        f.write(struct.pack("<BI", REC_FRONTIER, 4) + struct.pack("<i", 3))
+    s = StableStore(str(path))
+    assert not s.crc_framing and s.committed_prefix() == 3
+    before = open(path, "rb").read()
+    st = _store_applied(s)
+    assert s.take_snapshot(st["key"], st["val"], 3, wall_ns=1) == -1
+    assert s.snapshots_taken == 0
+    assert open(path, "rb").read() == before
+    _append(s, 4, 8)
+    s.append_frontier(7)
+    s.close()
+    r = StableStore(str(path))  # still v1, still consistent
+    assert not r.crc_framing and r.committed_prefix() == 7
+    r.close()
+
+
+# ------------------------------------------- replica-level recovery
+
+jax = pytest.importorskip("jax")
+
+from minpaxos_tpu.models.minpaxos import MinPaxosConfig  # noqa: E402
+from minpaxos_tpu.ops.kvstore import LIVE  # noqa: E402
+from minpaxos_tpu.ops.packed import join_i64  # noqa: E402
+from minpaxos_tpu.runtime.replica import (  # noqa: E402
+    CONTROL,
+    ReplicaServer,
+    RuntimeFlags,
+)
+from minpaxos_tpu.runtime.transport import FROM_CLIENT, FROM_PEER  # noqa: E402
+from minpaxos_tpu.wire.messages import MsgKind, Op, make_batch  # noqa: E402
+
+# same shapes as tests/test_pipeline.py, so the jitted step's compile
+# cache is shared across the files within one pytest process
+CFG = MinPaxosConfig(n_replicas=1, window=128, inbox=16, exec_batch=8,
+                     kv_pow2=8, catchup_rows=8, recovery_rows=8,
+                     gossip_ticks=1)
+CID = 7
+
+
+def _mk_server(tmp_path, name: str, **over) -> ReplicaServer:
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    flags = RuntimeFlags(pipeline=False, durable=True, store_dir=str(d),
+                         **over)
+    return ReplicaServer(0, [("127.0.0.1", 7077)], CFG, flags)
+
+
+def _elect(srv: ReplicaServer) -> None:
+    srv.queue.put((CONTROL, 0, "be_the_leader", None))
+    for _ in range(20):
+        if srv._drain(0.001):
+            srv._become_leader()
+        srv._device_tick(srv.inbox)
+        if srv.snapshot["prepared"]:
+            return
+    raise AssertionError(f"never prepared: {srv.snapshot}")
+
+
+def _trace(n_frames: int, seed: int) -> list[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    rows = CFG.inbox
+    return [make_batch(
+        MsgKind.PROPOSE,
+        cmd_id=(1000 + f * rows + np.arange(rows)).astype(np.int32),
+        op=np.full(rows, int(Op.PUT), np.uint8),
+        key=rng.integers(0, 40, rows).astype(np.int64),
+        val=rng.integers(1, 1 << 20, rows).astype(np.int64),
+        timestamp=0) for f in range(n_frames)]
+
+
+def _feed(srv: ReplicaServer, frames: list[np.ndarray],
+          extra_ticks: int = 12) -> None:
+    for frame in frames:
+        srv.queue.put((FROM_CLIENT, CID, MsgKind.PROPOSE, frame))
+    for _ in range(3 * len(frames) + extra_ticks):
+        srv._drain(0.001)
+        srv._device_tick(srv.inbox)
+    srv._flush_inflight()
+
+
+def _live_pairs(srv: ReplicaServer) -> np.ndarray:
+    """The device KV table's live (key, val) pairs, key-sorted."""
+    kv = srv.state.kv
+    live = np.asarray(kv.slot) == LIVE
+    keys = join_i64(np.asarray(kv.key_hi)[live],
+                    np.asarray(kv.key_lo)[live])
+    v = np.asarray(kv.val)
+    vals = join_i64(v[live, 0], v[live, 1])
+    out = np.zeros(len(keys), SNAP_DT)
+    order = np.argsort(keys, kind="stable")
+    out["key"], out["val"] = keys[order], vals[order]
+    return out
+
+
+def test_replica_recovery_from_snapshot_equals_full_log(tmp_path):
+    """End-to-end restart equivalence: a replica that snapshotted (and
+    truncated) mid-trace recovers byte-identical applied KV state and
+    frontier to a twin that kept its full log — through the real
+    _recover_from_store path, not a store-level simulation."""
+    trace = _trace(6, seed=23)
+    frontiers = {}
+    for name, with_snap in (("snap", True), ("full", False)):
+        srv = _mk_server(tmp_path, name, snapshots=with_snap)
+        try:
+            _elect(srv)
+            _feed(srv, trace[:3])
+            if with_snap:
+                for _ in range(2):  # second one actually truncates
+                    srv._take_snapshot(int(srv.snapshot["executed"]))
+                assert srv.store.snapshots_taken == 2
+            _feed(srv, trace[3:])
+            frontiers[name] = int(srv.snapshot["frontier"])
+        finally:
+            srv.store.close()
+    assert frontiers["snap"] == frontiers["full"] == 6 * CFG.inbox - 1
+    rec = {}
+    for name in ("snap", "full"):
+        srv = _mk_server(tmp_path, name)
+        assert srv.store.recovered
+        srv._recover_from_store()
+        rec[name] = srv
+    try:
+        assert rec["snap"].store.base >= 0  # replayed snapshot+suffix
+        assert rec["full"].store.base == -1  # replayed the whole log
+        # window_base is a slide cursor, not applied state — its
+        # replay-time value depends on replay chunking and catches up
+        # on the next live ticks, so only its validity is pinned
+        for srv in rec.values():
+            assert int(srv.state.window_base) <= \
+                int(srv.state.committed_upto) + 1
+        for field in ("committed_upto", "executed_upto"):
+            assert int(getattr(rec["snap"].state, field)) == \
+                int(getattr(rec["full"].state, field)), field
+        assert _live_pairs(rec["snap"]).tobytes() == \
+            _live_pairs(rec["full"]).tobytes()
+    finally:
+        rec["snap"].store.close()
+        rec["full"].store.close()
+
+
+def test_wire_snapshot_install_on_wiped_replica(tmp_path):
+    """The SNAP_META/SNAP_ROWS catch-up path: a replica with no log at
+    all installs a donor's snapshot through its real drain loop — KV
+    pairs into the device table, cursors to frontier+1, and the
+    snapshot into its OWN store so its next restart replays from it."""
+    donor = _mk_server(tmp_path, "donor")
+    try:
+        _elect(donor)
+        _feed(donor, _trace(4, seed=31))
+        donor._take_snapshot(int(donor.snapshot["executed"]))
+        fr = donor.store.snap_frontier
+        pairs = donor.store.snapshot_pairs
+        assert fr == 4 * CFG.inbox - 1 and len(pairs) > 0
+        donor_state = _live_pairs(donor).tobytes()
+    finally:
+        donor.store.close()
+
+    rx = _mk_server(tmp_path, "wiped")
+    try:
+        meta = make_batch(MsgKind.SNAP_META, leader_id=1, frontier=fr,
+                          count=len(pairs), seq=1)
+        rx.queue.put((FROM_PEER, 1, MsgKind.SNAP_META, meta))
+        # ship the pairs in two frames to exercise reassembly
+        mid = len(pairs) // 2
+        for ch in (pairs[:mid], pairs[mid:]):
+            rows = make_batch(MsgKind.SNAP_ROWS, frontier=fr,
+                              key=np.ascontiguousarray(ch["key"]),
+                              val=np.ascontiguousarray(ch["val"]))
+            rx.queue.put((FROM_PEER, 1, MsgKind.SNAP_ROWS, rows))
+        rx._drain(0.001)
+        assert rx.snapshot["frontier"] == fr
+        assert int(rx.state.committed_upto) == fr
+        assert int(rx.state.window_base) == fr + 1
+        assert _live_pairs(rx).tobytes() == donor_state
+        # installed into its own store: base moved (wire-install is
+        # the one live rebase) and a restart replays from it
+        assert rx.store.snap_frontier == fr and rx.store.base == fr
+    finally:
+        rx.store.close()
+
+    back = _mk_server(tmp_path, "wiped")
+    try:
+        assert back.store.recovered
+        back._recover_from_store()
+        assert _live_pairs(back).tobytes() == donor_state
+        assert int(back.state.committed_upto) == fr
+    finally:
+        back.store.close()

@@ -291,6 +291,9 @@ def test_evaluate_criteria_joined_timeline():
     assert crit == {"admission_organic": True,
                     "overload_alarm_journaled": True,
                     "partition_detected_in_window": True,
+                    # vacuously true: no crash_restart phase in the
+                    # synthetic card's manifest (paxdur)
+                    "crash_detected_and_attributed": True,
                     "exactly_once": True, "ok": True}
     # shed outside the overload phase is NOT organic
     crit = evaluate_criteria(_synthetic_card(warmup_shed=3,
@@ -305,6 +308,30 @@ def test_evaluate_criteria_joined_timeline():
     # a partition phase with zero watcher alarms is NOT a pass
     crit = evaluate_criteria(_synthetic_card(edges=good["alarm_edges"]))
     assert not crit["partition_detected_in_window"]
+    # crash_restart criterion (paxdur), quantified like the chaos
+    # campaign's _stall_verdict: a mid-window raise->clear flap does
+    # not negate a detection that named the corpse, but an alarm that
+    # never clears — or zero alarms at all — sinks it
+    crash = _synthetic_card(alarms=list(good["alarms"]),
+                            edges=list(good["alarm_edges"]))
+    crash["phases"].append(
+        {"ordinal": 4, "name": "crash", "kind": "crash_restart",
+         "t0_wall": 142.0, "t1_wall": 156.0,
+         "cluster": {"coalesce_admission_rejects": 0}})
+    crash["manifest"] = {"phases": [
+        {"name": "crash", "kind": "crash_restart",
+         "crash": {"target": 2}}]}
+    assert not evaluate_criteria(crash)["crash_detected_and_attributed"]
+    flap = {"detector": "frontier_stall", "subject": 2,
+            "t_raised": 144.0, "t_cleared": 146.0, "phase": "crash",
+            "in_fault_window": True, "cleared_after_heal": False}
+    hit = {"detector": "frontier_stall", "subject": 2,
+           "t_raised": 147.0, "t_cleared": 151.0, "phase": "crash",
+           "in_fault_window": True, "cleared_after_heal": True}
+    crash["alarms"] += [flap, hit]
+    assert evaluate_criteria(crash)["crash_detected_and_attributed"]
+    crash["alarms"][-1] = dict(hit, t_cleared=None)
+    assert not evaluate_criteria(crash)["crash_detected_and_attributed"]
 
 
 # -------------------------------------- multi-process swarm (real IO)

@@ -1,10 +1,13 @@
 #!/usr/bin/env python
-"""paxchaos campaign runner: seeded network-fault schedules against a
-real in-process cluster, invariant-checked after every one.
+"""paxchaos campaign runner: seeded network- and process-fault
+schedules against a real in-process cluster, invariant-checked after
+every one.
 
-    tools/chaos.py                      # all 9 schedules, default seed
+    tools/chaos.py                      # all 11 schedules, default seed
     tools/chaos.py --schedules flex_partition  # N=5 (q1=4, q2=2):
                                        # starve the q2-sized island
+    tools/chaos.py --schedules crash_restart_heal  # kill/restart a
+                                       # durable replica under load
     tools/chaos.py --seeds 7,1234      # replay specific seeds
     tools/chaos.py --schedules isolated_leader --seeds 42
     tools/chaos.py --smoke             # CI gate: 2 fixed seeds, quick

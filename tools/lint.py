@@ -6,6 +6,7 @@
     python tools/lint.py --rules wire-contract,concurrency
     python tools/lint.py --list-rules
     python tools/lint.py --print-wire-golden   # regen the wire ledger
+    python tools/lint.py --print-store-golden  # regen the store ledger
 
 Exit status: 0 = clean, 1 = violations, 2 = usage error.
 
@@ -46,6 +47,35 @@ def _print_wire_golden() -> None:
     print("}")
 
 
+def _print_store_golden() -> None:
+    """Emit the current tree's stable-store ledger (paste into
+    analysis/store_golden.py when legitimately extending the contract)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_stable_store", REPO_ROOT / "minpaxos_tpu/runtime/stable.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    tags = sorted((n, v) for n, v in vars(mod).items()
+                  if n.startswith("REC_") and isinstance(v, int))
+    print("GOLDEN_REC_TAGS: dict[str, int] = {")
+    for name, value in sorted(tags, key=lambda nv: nv[1]):
+        print(f'    "{name}": {value},')
+    print("}")
+    print("GOLDEN_MAGICS: dict[str, bytes] = {")
+    for name in ("MAGIC_V1", "MAGIC"):
+        print(f'    "{name}": {getattr(mod, name)!r},')
+    print("}")
+    print("GOLDEN_STRUCT_FMTS: dict[str, str] = {")
+    for name in ("_HDR", "_CRC", "_FRONTIER", "_SNAP_HDR"):
+        print(f'    "{name}": "{getattr(mod, name).format}",')
+    print("}")
+    print("GOLDEN_ROW_BYTES: dict[str, int] = {")
+    for name in ("SLOT_DT", "SNAP_DT"):
+        print(f'    "{name}": {getattr(mod, name).itemsize},')
+    print("}")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "paxlint", description=__doc__,
@@ -59,6 +89,8 @@ def main(argv=None) -> int:
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--print-wire-golden", action="store_true",
                    help="emit the current wire ledger and exit")
+    p.add_argument("--print-store-golden", action="store_true",
+                   help="emit the current stable-store ledger and exit")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -68,6 +100,9 @@ def main(argv=None) -> int:
         return 0
     if args.print_wire_golden:
         _print_wire_golden()
+        return 0
+    if args.print_store_golden:
+        _print_store_golden()
         return 0
 
     rules = None

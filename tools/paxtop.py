@@ -10,8 +10,10 @@ backlog, paxchaos injected-fault totals and narrow-anchor fallbacks
 (a running chaos campaign or a flapping narrow view is visible
 without a trace dump), the paxtrace TRACE column (sampled spans
 collected / ring-overwrite drops — whether tools/tail.py has data to
-attribute), p50/p99 tick wall from the typed histogram, and the
-paxwatch HEALTH column (the newest WARN-or-worse journal event per
+attribute), p50/p99 tick wall from the typed histogram, the paxdur
+SNAP column (snapshots taken / last-snapshot age / on-disk redo log
+bytes — whether the truncation policy is actually bounding disk), and
+the paxwatch HEALTH column (the newest WARN-or-worse journal event per
 replica + its age). Below the table, an EVENTS tail pane shows the
 newest cluster journal events (elections, leader changes, chaos
 installs, store-corruption recoveries, alarms) from the master's
@@ -83,7 +85,7 @@ DERIVED_ROW_KEYS = (
     "dispatches", "ticks", "idle_skips", "committed", "chaos_injected",
     "narrow_fallbacks", "trace_spans", "trace_dropped", "exec_backlog",
     "mix_pct", "tick_p50_ms", "tick_p99_ms", "commits_per_s",
-    "coalesce", "health")
+    "coalesce", "snap", "health")
 EVENT_ROW_KEYS = ("rid", "t_wall_s", "age_s", "kind", "severity",
                   "subject", "value", "aux", "trace_id")
 SOAK_ROW_KEYS = ("ordinal", "phase", "elapsed_s", "planned_s", "rid")
@@ -236,6 +238,16 @@ def _derive(resp: dict, prev: dict | None, dt: float) -> list[dict]:
             "occ_p50": chist.get("p50", 0.0),
             "queue_depth": counters.get("ingress_queue_depth", 0),
         }
+        # paxdur durability health: last-snapshot age, on-disk redo log
+        # bytes, snapshots taken — log_bytes climbing without bound (or
+        # age frozen at -1 on a durable server) means the snapshot
+        # policy is not engaging; all zeros/-1 on a -nosnap or
+        # non-durable server (keys stay present: stable schema)
+        row["snap"] = {
+            "age_s": counters.get("snap_age_s", -1),
+            "log_bytes": counters.get("store_log_bytes", 0),
+            "count": counters.get("snap_count", 0),
+        }
         ops = None
         if prev is not None and dt > 0:
             for p in prev.get("replicas", []):
@@ -270,6 +282,18 @@ def _fmt_coalesce(c: dict | None) -> str:
             f"/{_abbrev(c['rejects'])}")
 
 
+def _fmt_snap(s: dict | None) -> str:
+    """SNAP column: snapshots-taken/last-age/log-bytes — a durable
+    server under load shows the count climbing and log bytes sawtoothing
+    under the policy threshold; '-' age means never snapshotted."""
+    if not s:
+        return "-"
+    age = s.get("age_s", -1)
+    age_s = ("-" if age < 0
+             else f"{age:.0f}s" if age < 600 else f"{age / 60:.0f}m")
+    return f"{s.get('count', 0)}/{age_s}/{_abbrev(s.get('log_bytes', 0))}"
+
+
 def _fmt_health(h: dict | None) -> str:
     if not h:
         return "-"
@@ -299,7 +323,7 @@ def _render(resp: dict, rows: list[dict], clear: bool,
            f"{'COMMIT/S':>9} {'BACKLOG':>8} {'DISP':>8} {'FULL%':>6} "
            f"{'FUSE%':>6} {'NARR%':>6} {'SKIPS':>8} {'CHAOS':>7} "
            f"{'NARRFB':>6} {'TRACE':>11} {'p50ms':>7} {'p99ms':>8} "
-           f"{'COALESCE':>13} {'HEALTH':<18}")
+           f"{'COALESCE':>13} {'SNAP':>12} {'HEALTH':<18}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for r in rows:
@@ -321,6 +345,7 @@ def _render(resp: dict, rows: list[dict], clear: bool,
             f"{r['tick_p50_ms']:>7.2f} "
             f"{r['tick_p99_ms']:>8.2f} "
             f"{_fmt_coalesce(r.get('coalesce')):>13} "
+            f"{_fmt_snap(r.get('snap')):>12} "
             f"{_fmt_health(r.get('health')):<18}")
     if events:
         # paxwatch EVENTS tail pane: the newest journal events across

@@ -375,6 +375,62 @@ def collect_soak_rows(repo: Path = REPO) -> dict | None:
     }
 
 
+def collect_durability_rows(repo: Path = REPO) -> list[dict]:
+    """paxdur durability evidence from the committed artifacts: per
+    durable CHAOS.json run the snapshot/truncation counts, redo-log
+    bytes freed vs the final on-disk size (is truncation actually
+    bounding disk), and the worst recovery wall from EV_RECOVERY;
+    plus the SOAK.json crash_restart verdict (snapshot/recovery event
+    totals and the crash-attribution criterion). Trended per PR so a
+    change that quietly stops snapshots from engaging — or makes
+    recovery walltime blow up — shows in the same table as the
+    throughput it bought."""
+    rows: list[dict] = []
+    chaos = repo / "CHAOS.json"
+    if chaos.exists():
+        try:
+            doc = json.load(open(chaos))
+        except (OSError, json.JSONDecodeError) as e:
+            rows.append({"artifact": chaos.name, "error": repr(e)[:60]})
+            doc = {"runs": []}
+        for r in doc.get("runs", []):
+            d = r.get("durability")
+            if not d:
+                continue
+            lb = d.get("log_bytes") or {}
+            rows.append({
+                "artifact": chaos.name,
+                "run": f"{r.get('schedule')}@{r.get('seed')}",
+                "snapshots": d.get("snapshots"),
+                "truncations": d.get("truncations"),
+                "bytes_freed": d.get("bytes_freed"),
+                "log_bytes_final_max": (max(lb.values())
+                                        if lb else None),
+                "recovery_ms": d.get("recovery_ms_max"),
+                "ok": r.get("ok"),
+            })
+    soak_p = repo / "SOAK.json"
+    if soak_p.exists():
+        try:
+            card = json.load(open(soak_p))
+        except (OSError, json.JSONDecodeError):
+            card = None
+        ec = (card or {}).get("event_counts") or {}
+        if ec.get("snapshot") or ec.get("recovery"):
+            rows.append({
+                "artifact": soak_p.name,
+                "run": card.get("name"),
+                "snapshots": ec.get("snapshot", 0),
+                "truncations": ec.get("truncate", 0),
+                "bytes_freed": None,
+                "log_bytes_final_max": None,
+                "recovery_ms": None,
+                "ok": (card.get("criteria")
+                       or {}).get("crash_detected_and_attributed"),
+            })
+    return rows
+
+
 def collect_progress(repo: Path = REPO) -> list[dict]:
     """Last PROGRESS.jsonl sample per driver round: commits and LoC at
     round end — the repo-growth axis the bench trajectory rides on."""
@@ -403,7 +459,7 @@ def _fmt_counts(d: dict | None) -> str:
 
 
 def render_markdown(bench, tcp, progress, health=None, verify=None,
-                    soak=None) -> str:
+                    soak=None, durability=None) -> str:
     out = ["## Cross-PR bench trajectory (device loop)", ""]
     hdr = ("| artifact | when | platform | resident | inst/s | p50 ms "
            "| p99 ms | concurrent | shape | note |")
@@ -525,6 +581,25 @@ def render_markdown(bench, tcp, progress, health=None, verify=None,
                     f"| {_fmt(r['p50_ms'], 1)} | {_fmt(r['p99_ms'], 1)} "
                     f"| {_fmt(r['p999_ms'], 1)} "
                     f"| {r['alarms_in_window']}/{r['alarms_outside']} |")
+    if durability:
+        out += ["", "## Durability (paxdur: CHAOS.json durable runs + "
+                "SOAK.json)", "",
+                "| artifact | run | ok | snapshots | truncations "
+                "| bytes freed | final log max | recovery ms |",
+                "|" + "---|" * 8]
+        for d in durability:
+            if d.get("error"):
+                out.append(f"| {d['artifact']} | - | - | - | - | - | - "
+                           f"| {d['error']} |")
+                continue
+            out.append(
+                f"| {d['artifact']} | {d.get('run', '-')} "
+                f"| {'y' if d.get('ok') else 'n'} "
+                f"| {_fmt(d.get('snapshots'))} "
+                f"| {_fmt(d.get('truncations'))} "
+                f"| {_fmt(d.get('bytes_freed'))} "
+                f"| {_fmt(d.get('log_bytes_final_max'))} "
+                f"| {_fmt(d.get('recovery_ms'))} |")
     if progress:
         out += ["", "## Repo growth (PROGRESS.jsonl, per driver round)", "",
                 "| round | commits | LoC | wall h |", "|" + "---|" * 4]
@@ -550,13 +625,16 @@ def main(argv=None) -> int:
     health = collect_health_rows(repo)
     verify = collect_verify_rows(repo)
     soak = collect_soak_rows(repo)
+    durability = collect_durability_rows(repo)
     if args.json:
         print(json.dumps({"bench": bench, "tcp": tcp,
                           "progress": progress, "health": health,
-                          "verify": verify, "soak": soak},
+                          "verify": verify, "soak": soak,
+                          "durability": durability},
                          indent=1))
     else:
-        print(render_markdown(bench, tcp, progress, health, verify, soak))
+        print(render_markdown(bench, tcp, progress, health, verify,
+                              soak, durability))
     return 0
 
 
